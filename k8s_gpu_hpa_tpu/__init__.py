@@ -1,0 +1,30 @@
+"""k8s_gpu_hpa_tpu — TPU-native closed-loop accelerator autoscaling for Kubernetes.
+
+A ground-up rebuild of the capabilities of ``ashrafgt/k8s-gpu-hpa`` (mounted at
+``/root/reference``) for Cloud TPU node pools.  The reference composes four external
+NVIDIA/Prometheus components into a five-layer pipeline (see SURVEY.md §1):
+
+    L1 workload  →  L2 per-device exporter  →  L3 Prometheus + recording rule
+                 →  L4 custom-metrics adapter  →  L5 HorizontalPodAutoscaler
+
+This package supplies TPU-native implementations of every layer the reference pulls
+as a prebuilt image, plus the test harness the reference lacks (reference README.md:3
+admits "This solution has not been extensively tested"):
+
+- ``metrics``  — metric schema, Prometheus text exposition, a mini TSDB with a
+  scrape manager, and a recording-rule engine (L3 semantics, hardware-free).
+- ``exporter`` — the tpu-metrics-exporter: C++ core (cpp/exporter) with ctypes
+  bindings, chip→pod attribution, and stub sources for hardware-free tests
+  (TPU analog of the dcgm-exporter DaemonSet, dcgm-exporter.yaml:1-77).
+- ``control``  — custom-metrics API semantics and an ``autoscaling/v2`` HPA
+  controller with ``behavior`` stabilization (fixes the overshoot defect the
+  reference documents at README.md:123), plus a simulated cluster for
+  closed-loop integration tests.
+- ``loadgen``  — JAX load generators: single-chip ``jax.jit`` matmul busy-loop
+  (analog of the vectorAdd loop, cuda-test-deployment.yaml:19), a multi-host
+  ICI allreduce generator, and a ResNet-50 training workload.
+- ``models`` / ``ops`` / ``parallel`` — the flax model zoo, Pallas TPU kernels,
+  and mesh/sharding helpers backing the load generators.
+"""
+
+__version__ = "0.1.0"

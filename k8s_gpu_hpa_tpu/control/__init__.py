@@ -1,0 +1,26 @@
+from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPABehavior,
+    HPAController,
+    HPAStatus,
+    ObjectMetricSpec,
+    ScalingPolicy,
+    ScalingRules,
+)
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment, SimNode, SimPod
+
+__all__ = [
+    "AdapterRule",
+    "CustomMetricsAdapter",
+    "ObjectReference",
+    "HPABehavior",
+    "HPAController",
+    "HPAStatus",
+    "ObjectMetricSpec",
+    "ScalingPolicy",
+    "ScalingRules",
+    "SimCluster",
+    "SimDeployment",
+    "SimNode",
+    "SimPod",
+]

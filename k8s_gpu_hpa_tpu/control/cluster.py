@@ -1,0 +1,243 @@
+"""Simulated Kubernetes cluster for closed-loop testing (the harness the
+reference lacks — its test strategy is four manual curl probes, SURVEY.md §4).
+
+Models exactly the cluster behaviors the autoscaling loop depends on:
+
+- **nodes with TPU chips** (extended resource ``google.com/tpu``, the analog of
+  ``nvidia.com/gpu`` in cuda-test-deployment.yaml:22);
+- **pod lifecycle with start latency** — schedule + image pull + container
+  start, the dominant term in the reference's overshoot defect (README.md:123);
+- **deployments as scalable targets** (the HPA mutates ``spec.replicas`` via the
+  scale subresource, SURVEY.md §3.3);
+- **per-node exporter endpoints** producing real exposition text from simulated
+  chip activity, including the exporter's own collection interval (the reference
+  collects every 10 s, dcgm-exporter.yaml:37 — modeled so tests can prove our
+  faster interval fixes the lag);
+- **kube-state-metrics** ``kube_pod_labels`` series (the join input of
+  cuda-test-prometheusrule.yaml:13).
+
+Load model: a deployment's offered load is a function of time; in ``shared``
+mode the load is divided across running replicas (an autoscaling-responsive
+service), in ``per_pod`` mode every replica independently runs at the offered
+intensity (the reference's vectorAdd busy-loop, cuda-test-deployment.yaml:19).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable
+
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.metrics.schema import ChipSample, MetricFamily, families_from_chips
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+@dataclass
+class SimPod:
+    name: str
+    namespace: str
+    labels: dict[str, str]
+    deployment: str
+    chips_requested: int
+    phase: str = "Pending"  # Pending -> Running -> (deleted)
+    node: str | None = None
+    chip_ids: list[int] = field(default_factory=list)
+    created_at: float = 0.0
+    started_at: float | None = None
+
+
+@dataclass
+class SimNode:
+    name: str
+    num_chips: int
+    #: chip index -> pod name
+    allocations: dict[int, str] = field(default_factory=dict)
+
+    def free_chips(self) -> list[int]:
+        return [i for i in range(self.num_chips) if i not in self.allocations]
+
+
+class SimDeployment:
+    """Scalable target with an offered-load model."""
+
+    def __init__(
+        self,
+        cluster: "SimCluster",
+        name: str,
+        app_label: str,
+        chips_per_pod: int = 1,
+        namespace: str = "default",
+        load_fn: Callable[[float], float] | None = None,
+        load_mode: str = "shared",  # "shared" | "per_pod"
+    ):
+        self.cluster = cluster
+        self.name = name
+        self.namespace = namespace
+        self.app_label = app_label
+        self.chips_per_pod = chips_per_pod
+        self.load_fn = load_fn or (lambda t: 0.0)
+        assert load_mode in ("shared", "per_pod")
+        self.load_mode = load_mode
+        self.replicas = 0
+
+    def scale_to(self, replicas: int) -> None:
+        self.replicas = replicas
+        self.cluster.reconcile(self)
+
+    def pod_utilization(self, pod: SimPod) -> float:
+        """Current tensorcore utilization percent for one running pod."""
+        offered = self.load_fn(self.cluster.clock.now())
+        if self.load_mode == "per_pod":
+            return min(100.0, offered)
+        running = self.cluster.running_pods(self.name)
+        if not running:
+            return 0.0
+        return min(100.0, offered / len(running))
+
+
+class _NodeExporter:
+    """The per-node metrics endpoint, with a collection-interval cache: readings
+    refresh at most every ``sample_interval`` seconds, like dcgm-exporter's
+    ``-c`` flag (dcgm-exporter.yaml:37).  Serving is instantaneous; staleness
+    comes from the cache, exactly the reference's freshness bottleneck
+    (SURVEY.md §3.1)."""
+
+    def __init__(self, cluster: "SimCluster", node: SimNode, sample_interval: float):
+        self.cluster = cluster
+        self.node = node
+        self.sample_interval = sample_interval
+        self._cache: str | None = None
+        self._last_sample = -float("inf")
+
+    def fetch(self) -> str:
+        now = self.cluster.clock.now()
+        if self._cache is None or now - self._last_sample >= self.sample_interval:
+            self._cache = self._collect()
+            self._last_sample = now
+        return self._cache
+
+    def _collect(self) -> str:
+        chips: list[ChipSample] = []
+        attribution: dict[int, tuple[str, str]] = {}
+        for idx in range(self.node.num_chips):
+            pod_name = self.node.allocations.get(idx)
+            util = 0.0
+            hbm_used = 0.5e9
+            if pod_name is not None:
+                pod = self.cluster.pods[pod_name]
+                deployment = self.cluster.deployments[pod.deployment]
+                util = deployment.pod_utilization(pod)
+                hbm_used = 0.5e9 + 15.5e9 * util / 100.0
+                attribution[idx] = (pod.namespace, pod.name)
+            chips.append(
+                ChipSample(
+                    accel_index=idx,
+                    tensorcore_util=util,
+                    duty_cycle=min(100.0, util * 1.1),
+                    hbm_usage_bytes=hbm_used,
+                    hbm_total_bytes=16e9,
+                    hbm_bw_util=util * 0.6,
+                )
+            )
+        return encode_text(
+            families_from_chips(chips, node=self.node.name, attribution=attribution)
+        )
+
+
+class SimCluster:
+    """Nodes + pods + deployments + the two fake metric endpoints."""
+
+    def __init__(
+        self,
+        clock: VirtualClock,
+        nodes: list[tuple[str, int]] | None = None,
+        pod_start_latency: float = 12.0,
+        exporter_sample_interval: float = 1.0,
+    ):
+        self.clock = clock
+        self.nodes = {
+            name: SimNode(name, chips) for name, chips in (nodes or [("tpu-node-0", 8)])
+        }
+        self.pods: dict[str, SimPod] = {}
+        self.deployments: dict[str, SimDeployment] = {}
+        self.pod_start_latency = pod_start_latency
+        self._name_counter = itertools.count()
+        self.exporters = {
+            name: _NodeExporter(self, node, exporter_sample_interval)
+            for name, node in self.nodes.items()
+        }
+
+    # ---- deployment / pod lifecycle ---------------------------------------
+
+    def add_deployment(self, deployment: SimDeployment, replicas: int = 1) -> None:
+        self.deployments[deployment.name] = deployment
+        deployment.scale_to(replicas)
+
+    def deployment_pods(self, name: str) -> list[SimPod]:
+        return [p for p in self.pods.values() if p.deployment == name]
+
+    def running_pods(self, name: str) -> list[SimPod]:
+        return [p for p in self.deployment_pods(name) if p.phase == "Running"]
+
+    def reconcile(self, deployment: SimDeployment) -> None:
+        pods = sorted(self.deployment_pods(deployment.name), key=lambda p: p.created_at)
+        while len(pods) > deployment.replicas:
+            self._delete_pod(pods.pop())  # newest first, like ReplicaSet scale-down
+        while len(pods) < deployment.replicas:
+            pods.append(self._create_pod(deployment))
+
+    def _create_pod(self, deployment: SimDeployment) -> SimPod:
+        pod = SimPod(
+            name=f"{deployment.name}-{next(self._name_counter):04x}",
+            namespace=deployment.namespace,
+            labels={"app": deployment.app_label},
+            deployment=deployment.name,
+            chips_requested=deployment.chips_per_pod,
+            created_at=self.clock.now(),
+        )
+        self.pods[pod.name] = pod
+        self.clock.call_later(self.pod_start_latency, lambda: self._try_start(pod))
+        return pod
+
+    def _try_start(self, pod: SimPod) -> None:
+        if pod.name not in self.pods or pod.phase == "Running":
+            return
+        for node in self.nodes.values():
+            free = node.free_chips()
+            if len(free) >= pod.chips_requested:
+                pod.node = node.name
+                pod.chip_ids = free[: pod.chips_requested]
+                for idx in pod.chip_ids:
+                    node.allocations[idx] = pod.name
+                pod.phase = "Running"
+                pod.started_at = self.clock.now()
+                return
+        # No capacity: stay Pending, retry (kube-scheduler requeue).
+        self.clock.call_later(5.0, lambda: self._try_start(pod))
+
+    def _delete_pod(self, pod: SimPod) -> None:
+        if pod.node is not None:
+            node = self.nodes[pod.node]
+            for idx in pod.chip_ids:
+                node.allocations.pop(idx, None)
+        self.pods.pop(pod.name, None)
+
+    # ---- metric endpoints --------------------------------------------------
+
+    def exporter_fetch(self, node_name: str) -> str:
+        return self.exporters[node_name].fetch()
+
+    def kube_state_metrics_text(self) -> str:
+        """``kube_pod_labels`` for every pod (kube-state-metrics exports Pending
+        pods too; the rule's inner join plus the absent device metric is what
+        keeps them out of the average — SURVEY.md §3.2)."""
+        fam = MetricFamily("kube_pod_labels", "gauge", "Kubernetes pod labels")
+        for pod in self.pods.values():
+            fam.add(
+                1.0,
+                namespace=pod.namespace,
+                pod=pod.name,
+                label_app=pod.labels.get("app", ""),
+            )
+        return encode_text([fam])

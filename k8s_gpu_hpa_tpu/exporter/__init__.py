@@ -1,0 +1,1 @@
+"""Placeholder: populated by the exporter milestone (see package docstring)."""

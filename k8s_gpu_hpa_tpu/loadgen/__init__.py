@@ -1,0 +1,1 @@
+"""Placeholder: populated by the loadgen milestone (see package docstring)."""

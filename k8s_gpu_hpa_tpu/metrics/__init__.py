@@ -1,0 +1,33 @@
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    CHIP_METRICS,
+    ChipSample,
+    MetricFamily,
+    Sample,
+    TPU_DUTY_CYCLE,
+    TPU_HBM_BW_UTIL,
+    TPU_HBM_TOTAL,
+    TPU_HBM_USAGE,
+    TPU_TENSORCORE_UTIL,
+)
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text, parse_text
+from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+from k8s_gpu_hpa_tpu.metrics.rules import RecordingRule, RuleEvaluator, tpu_test_avg_rule
+
+__all__ = [
+    "CHIP_METRICS",
+    "ChipSample",
+    "MetricFamily",
+    "Sample",
+    "TPU_DUTY_CYCLE",
+    "TPU_HBM_BW_UTIL",
+    "TPU_HBM_TOTAL",
+    "TPU_HBM_USAGE",
+    "TPU_TENSORCORE_UTIL",
+    "encode_text",
+    "parse_text",
+    "Scraper",
+    "TimeSeriesDB",
+    "RecordingRule",
+    "RuleEvaluator",
+    "tpu_test_avg_rule",
+]

@@ -1,0 +1,139 @@
+"""Prometheus text exposition format: encoder and parser.
+
+The exporter↔Prometheus joint in the reference is the text format served on
+:9400/metrics (dcgm-exporter.yaml:31-32,40-41) and smoke-tested with
+``curl localhost:9400/metrics | grep dcgm_gpu_temp`` (README.md:42-47).  We
+implement both directions: ``encode_text`` is what the exporter serves (the C++
+core has an equivalent encoder; this one is the reference implementation its
+tests diff against) and ``parse_text`` is what our mini-Prometheus scraper uses,
+so the scrape contract is exercised end-to-end in tests.
+
+Format per the Prometheus exposition spec (text/plain; version=0.0.4): HELP/TYPE
+comment lines, then ``name{label="value",...} value`` sample lines with ``\\``,
+``\n`` and ``"`` escaped inside label values.
+"""
+
+from __future__ import annotations
+
+import math
+
+from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily, Sample
+
+_ESCAPES = {"\\": "\\\\", "\n": "\\n", '"': '\\"'}
+
+
+def _escape_label_value(v: str) -> str:
+    return "".join(_ESCAPES.get(ch, ch) for ch in v)
+
+
+def _unescape_label_value(v: str) -> str:
+    out: list[str] = []
+    i = 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", "n": "\n", '"': '"'}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def _format_value(value: float) -> str:
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def encode_text(families: list[MetricFamily]) -> str:
+    """Encode metric families into Prometheus text exposition format."""
+    lines: list[str] = []
+    for fam in families:
+        if fam.help:
+            lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.type}")
+        for sample in fam.samples:
+            if sample.labels:
+                labelstr = ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in sample.labels
+                )
+                lines.append(f"{fam.name}{{{labelstr}}} {_format_value(sample.value)}")
+            else:
+                lines.append(f"{fam.name} {_format_value(sample.value)}")
+    return "\n".join(lines) + "\n"
+
+
+def _parse_labels(body: str) -> tuple[tuple[str, str], ...]:
+    labels: list[tuple[str, str]] = []
+    i = 0
+    n = len(body)
+    while i < n:
+        # label name
+        j = body.index("=", i)
+        name = body[i:j].strip().lstrip(",").strip()
+        # opening quote
+        k = body.index('"', j)
+        # find closing quote honoring escapes
+        m = k + 1
+        while m < n:
+            if body[m] == "\\":
+                m += 2
+                continue
+            if body[m] == '"':
+                break
+            m += 1
+        labels.append((name, _unescape_label_value(body[k + 1 : m])))
+        i = m + 1
+    return tuple(sorted(labels))
+
+
+def parse_text(text: str) -> list[MetricFamily]:
+    """Parse Prometheus text exposition into metric families.
+
+    Tolerant of unknown metrics and interleaved comments, like a real scraper.
+    """
+    families: dict[str, MetricFamily] = {}
+
+    def fam(name: str) -> MetricFamily:
+        if name not in families:
+            families[name] = MetricFamily(name)
+        return families[name]
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP ") :]
+            name, _, help_ = rest.partition(" ")
+            fam(name).help = help_
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE ") :]
+            name, _, type_ = rest.partition(" ")
+            fam(name).type = type_ or "untyped"
+            continue
+        if line.startswith("#"):
+            continue
+        # sample line: name[{labels}] value [timestamp]; malformed lines are
+        # skipped, never fatal — a scraper must survive a corrupt exposition
+        try:
+            if "{" in line:
+                name = line[: line.index("{")]
+                close = line.rindex("}")
+                labels = _parse_labels(line[line.index("{") + 1 : close])
+                rest = line[close + 1 :].strip()
+            else:
+                parts = line.split()
+                name, rest = parts[0], " ".join(parts[1:])
+                labels = ()
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        fam(name).samples.append(Sample(value, labels))
+    return list(families.values())

@@ -1,0 +1,219 @@
+"""Recording-rule engine: the aggregation layer (L3) that defines the autoscale metric.
+
+The reference's single recording rule (cuda-test-prometheusrule.yaml:12-16) is the
+semantic heart of its pipeline:
+
+    record: cuda_test_gpu_avg
+    expr: avg(
+        max by(node,pod,namespace)(dcgm_gpu_utilization)
+        * on(pod) group_left(label_app)
+        max by(pod,label_app)(kube_pod_labels{label_app="cuda-test"})
+    )
+    labels: {namespace: default, deployment: cuda-test}
+
+Three load-bearing tricks, all preserved here (SURVEY.md §3.2):
+1. ``max by(pod)`` collapses multi-accelerator pods to their hottest device;
+2. the ``* on(pod) group_left`` inner-join against kube-state-metrics'
+   ``kube_pod_labels`` scopes device metrics to one app, because the device
+   metric carries a ``pod`` label but no app identity;
+3. the hard-coded ``namespace``/``deployment`` output labels are what lets
+   prometheus-adapter address the series as an Object metric on the Deployment.
+
+Rules are expression ASTs that (a) evaluate against the in-process TSDB for the
+closed-loop test harness and (b) render the equivalent PromQL via ``promql()``,
+from which ``deploy/tpu-test-prometheusrule.yaml`` is generated — one source of
+truth for both the tested semantics and the shipped manifest.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_gpu_hpa_tpu.metrics.schema import Sample, TPU_TENSORCORE_UTIL
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+
+Vector = list[Sample]
+
+
+class Expr:
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        raise NotImplementedError
+
+    def promql(self) -> str:
+        raise NotImplementedError
+
+
+@dataclass
+class Select(Expr):
+    """Instant vector selector: ``name{key="value",...}``."""
+
+    name: str
+    matchers: dict[str, str] = field(default_factory=dict)
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        return db.instant_vector(self.name, self.matchers, at)
+
+    def promql(self) -> str:
+        if not self.matchers:
+            return self.name
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(self.matchers.items()))
+        return f"{self.name}{{{inner}}}"
+
+
+def _project(sample: Sample, keys: tuple[str, ...]) -> tuple[tuple[str, str], ...]:
+    labels = dict(sample.labels)
+    return tuple((k, labels[k]) for k in keys if k in labels)
+
+
+@dataclass
+class MaxBy(Expr):
+    """``max by(k1,k2,...)(child)`` — collapse to max within each label group."""
+
+    keys: tuple[str, ...]
+    child: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        groups: dict[tuple[tuple[str, str], ...], float] = {}
+        for sample in self.child.evaluate(db, at):
+            key = _project(sample, self.keys)
+            if key not in groups or sample.value > groups[key]:
+                groups[key] = sample.value
+        return [Sample(v, k) for k, v in groups.items()]
+
+    def promql(self) -> str:
+        return f"max by({','.join(self.keys)})({self.child.promql()})"
+
+
+@dataclass
+class MulOnGroupLeft(Expr):
+    """``left * on(k) group_left(extra...) right`` — the app-scoping inner join.
+
+    For each left sample, find the right sample sharing the ``on`` label values
+    (must be unique on the right, as in PromQL); emit left.value * right.value
+    with the left label set plus the ``group_left`` labels copied from the right.
+    Left samples with no right match are dropped (inner-join filtering — this is
+    what removes pods not labeled with the target app).
+    """
+
+    left: Expr
+    right: Expr
+    on: tuple[str, ...]
+    group_left: tuple[str, ...] = ()
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        right_index: dict[tuple[tuple[str, str], ...], Sample] = {}
+        for sample in self.right.evaluate(db, at):
+            key = _project(sample, self.on)
+            if key in right_index:
+                raise ValueError(
+                    f"many-to-many match on {self.on}: duplicate right key {key}"
+                )
+            right_index[key] = sample
+        out: Vector = []
+        for sample in self.left.evaluate(db, at):
+            match = right_index.get(_project(sample, self.on))
+            if match is None:
+                continue
+            labels = dict(sample.labels)
+            right_labels = dict(match.labels)
+            for extra in self.group_left:
+                if extra in right_labels:
+                    labels[extra] = right_labels[extra]
+            out.append(Sample(sample.value * match.value, tuple(sorted(labels.items()))))
+        return out
+
+    def promql(self) -> str:
+        gl = ",".join(self.group_left)
+        return (
+            f"{self.left.promql()} * on({','.join(self.on)}) "
+            f"group_left({gl}) {self.right.promql()}"
+        )
+
+
+@dataclass
+class Avg(Expr):
+    """``avg(child)`` — collapse the whole vector to one unlabeled scalar sample."""
+
+    child: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        vec = self.child.evaluate(db, at)
+        if not vec:
+            return []
+        return [Sample(sum(s.value for s in vec) / len(vec), ())]
+
+    def promql(self) -> str:
+        return f"avg({self.child.promql()})"
+
+
+@dataclass
+class RecordingRule:
+    """``record:`` output series name, expression, and static output labels."""
+
+    record: str
+    expr: Expr
+    labels: dict[str, str] = field(default_factory=dict)
+    _last_keys: set[tuple[tuple[str, str], ...]] = field(default_factory=set, repr=False)
+
+    def evaluate_into(self, db: TimeSeriesDB, at: float | None = None) -> int:
+        """Evaluate and write the result series back into the TSDB.  Output
+        series that stop being produced get staleness markers (Prometheus rule
+        semantics) so a broken input pipeline propagates to consumers instead of
+        serving a frozen value for the whole lookback window."""
+        count = 0
+        ts = db.clock.now() if at is None else at
+        produced: set[tuple[tuple[str, str], ...]] = set()
+        for sample in self.expr.evaluate(db, at):
+            labels = dict(sample.labels)
+            labels.update(self.labels)
+            key = tuple(sorted(labels.items()))
+            db.append(self.record, key, sample.value, ts)
+            produced.add(key)
+            count += 1
+        for key in self._last_keys - produced:
+            db.mark_stale(self.record, key, ts)
+        self._last_keys = produced
+        return count
+
+
+class RuleEvaluator:
+    """Evaluates a rule group on a schedule (Prometheus default interval 30s; we
+    default to 1s to meet the 60s north-star latency budget — SURVEY.md §7
+    hard-part (b))."""
+
+    def __init__(self, db: TimeSeriesDB, rules: list[RecordingRule], interval: float = 1.0):
+        self.db = db
+        self.rules = rules
+        self.interval = interval
+
+    def evaluate_once(self) -> int:
+        return sum(rule.evaluate_into(self.db) for rule in self.rules)
+
+
+def tpu_test_avg_rule(
+    app: str = "tpu-test",
+    deployment: str = "tpu-test",
+    namespace: str = "default",
+    metric: str = TPU_TENSORCORE_UTIL,
+    record: str = "tpu_test_tensorcore_avg",
+) -> RecordingRule:
+    """The TPU analog of the reference's rule, same three-trick shape
+    (cuda-test-prometheusrule.yaml:13), with ``chip``-aware max: our device metric
+    is per-chip, so ``max by(node,pod,namespace)`` also collapses the chips of a
+    multi-chip slice pod — the axis the reference never had (SURVEY.md §7(c))."""
+    expr = Avg(
+        MulOnGroupLeft(
+            left=MaxBy(("node", "pod", "namespace"), Select(metric)),
+            right=MaxBy(
+                ("pod", "label_app"),
+                Select("kube_pod_labels", {"label_app": app}),
+            ),
+            on=("pod",),
+            group_left=("label_app",),
+        )
+    )
+    return RecordingRule(
+        record=record,
+        expr=expr,
+        labels={"namespace": namespace, "deployment": deployment},
+    )

@@ -1,0 +1,122 @@
+"""Metric schema for the tpu-metrics-exporter.
+
+The reference exports NVIDIA DCGM gauges — ``dcgm_gpu_utilization`` (consumed by
+the recording rule, cuda-test-prometheusrule.yaml:13) and ``dcgm_gpu_temp``
+(smoke-tested at README.md:46) — each labeled with ``node``/``pod``/``namespace``
+so Prometheus can attribute device activity to Kubernetes objects
+(dcgm-exporter.yaml:33-34 enables that attribution).
+
+The TPU-native schema mirrors the libtpu runtime-metrics service (the same source
+``tpu-info`` reads on localhost:8431): tensorcore utilization, duty cycle, and HBM
+capacity/bandwidth, labeled additionally with the chip index since one pod may own
+several chips of a slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Canonical metric names (the string contracts the whole pipeline pivots on —
+# the analog of `dcgm_gpu_utilization` in cuda-test-prometheusrule.yaml:13).
+TPU_TENSORCORE_UTIL = "tpu_tensorcore_utilization"  # percent, 0-100
+TPU_DUTY_CYCLE = "tpu_duty_cycle"  # percent, 0-100
+TPU_HBM_USAGE = "tpu_hbm_memory_usage_bytes"  # bytes
+TPU_HBM_TOTAL = "tpu_hbm_memory_total_bytes"  # bytes
+TPU_HBM_BW_UTIL = "tpu_hbm_memory_bandwidth_utilization"  # percent, 0-100
+
+#: name -> (type, help text); all gauges, like the DCGM fields the reference uses.
+CHIP_METRICS: dict[str, tuple[str, str]] = {
+    TPU_TENSORCORE_UTIL: ("gauge", "TensorCore utilization percent per TPU chip"),
+    TPU_DUTY_CYCLE: ("gauge", "Accelerator duty cycle percent per TPU chip"),
+    TPU_HBM_USAGE: ("gauge", "HBM memory used in bytes per TPU chip"),
+    TPU_HBM_TOTAL: ("gauge", "Total HBM memory in bytes per TPU chip"),
+    TPU_HBM_BW_UTIL: ("gauge", "HBM bandwidth utilization percent per TPU chip"),
+}
+
+
+@dataclass(frozen=True)
+class Sample:
+    """One exposition sample: value plus its label set."""
+
+    value: float
+    labels: tuple[tuple[str, str], ...] = ()
+
+    @staticmethod
+    def make(value: float, **labels: str) -> "Sample":
+        return Sample(value, tuple(sorted(labels.items())))
+
+    def label(self, key: str) -> str | None:
+        for k, v in self.labels:
+            if k == key:
+                return v
+        return None
+
+
+@dataclass
+class MetricFamily:
+    """A named metric with TYPE/HELP metadata and its samples."""
+
+    name: str
+    type: str = "gauge"
+    help: str = ""
+    samples: list[Sample] = field(default_factory=list)
+
+    def add(self, value: float, **labels: str) -> None:
+        self.samples.append(Sample.make(value, **labels))
+
+
+@dataclass(frozen=True)
+class ChipSample:
+    """One reading of all per-chip gauges, before exposition.
+
+    Produced by a metrics source (libtpu gRPC on hardware, stub in tests);
+    ``accel_index`` is the device index the PodResources mapping joins on
+    (the TPU analog of `--kubernetes-gpu-id-type device-name`,
+    dcgm-exporter.yaml:37).
+    """
+
+    accel_index: int
+    tensorcore_util: float  # 0-100
+    duty_cycle: float  # 0-100
+    hbm_usage_bytes: float
+    hbm_total_bytes: float
+    hbm_bw_util: float  # 0-100
+
+    def as_metric_values(self) -> dict[str, float]:
+        return {
+            TPU_TENSORCORE_UTIL: self.tensorcore_util,
+            TPU_DUTY_CYCLE: self.duty_cycle,
+            TPU_HBM_USAGE: self.hbm_usage_bytes,
+            TPU_HBM_TOTAL: self.hbm_total_bytes,
+            TPU_HBM_BW_UTIL: self.hbm_bw_util,
+        }
+
+
+def families_from_chips(
+    chips: list[ChipSample],
+    node: str,
+    attribution: dict[int, tuple[str, str]] | None = None,
+) -> list[MetricFamily]:
+    """Build exposition families from chip readings plus pod attribution.
+
+    ``attribution`` maps accel_index -> (namespace, pod); chips not present in the
+    map are exported with empty pod labels — exactly how dcgm-exporter behaves for
+    GPUs not allocated to any pod (attribution is enabled by
+    DCGM_EXPORTER_KUBERNETES=true, dcgm-exporter.yaml:33-34).
+    """
+    attribution = attribution or {}
+    fams = {
+        name: MetricFamily(name, type_, help_)
+        for name, (type_, help_) in CHIP_METRICS.items()
+    }
+    for chip in chips:
+        namespace, pod = attribution.get(chip.accel_index, ("", ""))
+        for name, value in chip.as_metric_values().items():
+            fams[name].add(
+                value,
+                node=node,
+                namespace=namespace,
+                pod=pod,
+                chip=str(chip.accel_index),
+            )
+    return list(fams.values())

@@ -1,0 +1,166 @@
+"""Mini time-series database + scrape manager (the L3 stand-in for tests/sim).
+
+In production L3 is kube-prometheus-stack, reused as-is because it is
+accelerator-agnostic (SURVEY.md §2b); only the scrape job and rules are ours
+(deploy/kube-prometheus-stack-values.yaml).  For the hardware-free closed-loop
+harness the reference never had (its testing is manual curl probes,
+README.md:42-47,80-88), this module reproduces the two Prometheus behaviors the
+pipeline depends on:
+
+- **scrape**: pull text exposition from targets every interval (reference scrapes
+  at 1 s, kube-prometheus-stack-values.yaml:5) and attach target metadata labels —
+  the ``node`` relabel of kube-prometheus-stack-values.yaml:13-16.
+- **instant query with staleness**: the newest point per series within a lookback
+  window (Prometheus default 5 min), which is what both the recording-rule engine
+  and the custom-metrics adapter consume.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable
+
+from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+from k8s_gpu_hpa_tpu.metrics.schema import Sample
+from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
+
+LabelSet = tuple[tuple[str, str], ...]
+
+
+@dataclass
+class _Series:
+    labels: LabelSet
+    points: list[tuple[float, float]] = field(default_factory=list)  # (ts, value)
+
+    def latest_at(self, at: float, lookback: float) -> float | None:
+        # Points arrive in time order; scan from the end.  A NaN point is a
+        # staleness marker (Prometheus semantics: written when a scrape fails or
+        # a rule's output series disappears) and ends the series immediately.
+        for ts, value in reversed(self.points):
+            if ts <= at:
+                if math.isnan(value) or at - ts > lookback:
+                    return None
+                return value
+        return None
+
+
+class TimeSeriesDB:
+    """Append-only store of named series, queried as instant vectors."""
+
+    def __init__(self, clock: Clock | None = None, lookback: float = 300.0):
+        self.clock = clock or SystemClock()
+        self.lookback = lookback
+        self._data: dict[str, dict[LabelSet, _Series]] = {}
+
+    def append(
+        self, name: str, labels: LabelSet, value: float, ts: float | None = None
+    ) -> None:
+        ts = self.clock.now() if ts is None else ts
+        series = self._data.setdefault(name, {}).setdefault(labels, _Series(labels))
+        series.points.append((ts, value))
+
+    def instant_vector(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        at: float | None = None,
+    ) -> list[Sample]:
+        """All series of ``name`` matching label equalities, at their latest value."""
+        at = self.clock.now() if at is None else at
+        out: list[Sample] = []
+        for series in self._data.get(name, {}).values():
+            if matchers:
+                labels = dict(series.labels)
+                if any(labels.get(k) != v for k, v in matchers.items()):
+                    continue
+            value = series.latest_at(at, self.lookback)
+            if value is not None:
+                out.append(Sample(value, series.labels))
+        return out
+
+    def latest(self, name: str, matchers: dict[str, str] | None = None) -> float | None:
+        """Scalar convenience: value of the single matching series, else None."""
+        vec = self.instant_vector(name, matchers)
+        if not vec:
+            return None
+        if len(vec) > 1:
+            raise ValueError(f"query for {name} matched {len(vec)} series, expected 1")
+        return vec[0].value
+
+    def mark_stale(self, name: str, labels: LabelSet, ts: float | None = None) -> None:
+        """Write a staleness marker ending the series now (Prometheus writes
+        these when a target fails to scrape or a rule stops producing)."""
+        self.append(name, labels, float("nan"), ts)
+
+    def series_names(self) -> list[str]:
+        return sorted(self._data)
+
+
+@dataclass
+class ScrapeTarget:
+    """One endpoint: ``fetch`` returns exposition text (HTTP GET in production).
+
+    ``attached_labels`` are merged onto every scraped sample, overriding any
+    collision — this implements the reference's relabel_config that stamps the
+    Kubernetes node name onto each sample (kube-prometheus-stack-values.yaml:13-16).
+    """
+
+    fetch: Callable[[], str]
+    attached_labels: dict[str, str] = field(default_factory=dict)
+    name: str = ""
+    healthy: bool = True
+    #: series produced by the last successful scrape, for staleness marking
+    last_series: set[tuple[str, LabelSet]] = field(default_factory=set)
+
+
+class Scraper:
+    """Pulls all targets into the TSDB; drive via ``scrape_once`` on a schedule."""
+
+    def __init__(self, db: TimeSeriesDB, interval: float = 1.0):
+        self.db = db
+        self.interval = interval
+        self.targets: list[ScrapeTarget] = []
+
+    def add_target(
+        self, fetch: Callable[[], str], name: str = "", **attached_labels: str
+    ) -> ScrapeTarget:
+        target = ScrapeTarget(fetch=fetch, attached_labels=attached_labels, name=name)
+        self.targets.append(target)
+        return target
+
+    def remove_target(self, target: ScrapeTarget) -> None:
+        self.targets.remove(target)
+
+    def scrape_once(self) -> int:
+        """Scrape every target.  A failing target gets staleness markers on all
+        series it produced last time (Prometheus semantics: a down target's
+        series go stale at the next scrape, they don't linger for the lookback
+        window).  Returns number of samples ingested."""
+        count = 0
+        for target in self.targets:
+            ts = self.db.clock.now()
+            try:
+                text = target.fetch()
+            except Exception:
+                if target.healthy:
+                    for name, labels in target.last_series:
+                        self.db.mark_stale(name, labels, ts)
+                target.healthy = False
+                target.last_series = set()
+                continue
+            target.healthy = True
+            produced: set[tuple[str, LabelSet]] = set()
+            for fam in parse_text(text):
+                for sample in fam.samples:
+                    labels = dict(sample.labels)
+                    labels.update(target.attached_labels)
+                    key = tuple(sorted(labels.items()))
+                    self.db.append(fam.name, key, sample.value, ts)
+                    produced.add((fam.name, key))
+                    count += 1
+            # series that vanished from the exposition also go stale
+            for name, labels in target.last_series - produced:
+                self.db.mark_stale(name, labels, ts)
+            target.last_series = produced
+        return count

@@ -1,0 +1,1 @@
+"""Placeholder: populated by the models milestone (see package docstring)."""

@@ -1,0 +1,1 @@
+"""Placeholder: populated by the ops milestone (see package docstring)."""

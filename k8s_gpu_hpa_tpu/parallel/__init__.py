@@ -1,0 +1,1 @@
+"""Placeholder: populated by the parallel milestone (see package docstring)."""

@@ -1,0 +1,3 @@
+from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock, VirtualClock
+
+__all__ = ["Clock", "SystemClock", "VirtualClock"]

@@ -1,0 +1,17 @@
+"""Test harness configuration.
+
+Tests never require TPU hardware (the gap SURVEY.md §4 says this rebuild must
+close): JAX runs on CPU with 8 virtual devices so every sharding/collective path
+is exercised as an 8-chip mesh, and the metrics/control pipeline runs on stub
+sources and a virtual clock.
+
+Environment must be set before the first ``import jax`` anywhere in the test
+process, which is why it lives at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

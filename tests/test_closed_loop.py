@@ -1,0 +1,182 @@
+"""Closed-loop integration tests: the entire L0→L5 pipeline in virtual time.
+
+These are the automated equivalent of the reference's final manual test —
+"double the workload via kubectl exec, watch replicas appear" (README.md:112-121)
+— plus the scenarios the reference can't test at all: the north-star scale-up
+latency budget (BASELINE.md: 1→4 within 60 s of utilization crossing 40%), the
+overshoot defect and its behavior fix, scale-down, multi-chip slice pods, and
+multi-node scrape."""
+
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import HPABehavior, ScalingPolicy, ScalingRules
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline, PipelineIntervals
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def step_load(t0, low, high):
+    """Offered load: low before t0, high after (the kubectl-exec load doubling)."""
+    return lambda t: high if t >= t0 else low
+
+
+def make_pipeline(load_fn, load_mode="shared", **kw):
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=kw.pop("nodes", [("tpu-node-0", 8)]),
+        pod_start_latency=kw.pop("pod_start_latency", 12.0),
+        exporter_sample_interval=kw.pop("exporter_sample_interval", 1.0),
+    )
+    deployment = SimDeployment(
+        cluster,
+        name="tpu-test",
+        app_label="tpu-test",
+        chips_per_pod=kw.pop("chips_per_pod", 1),
+        load_fn=load_fn,
+        load_mode=load_mode,
+    )
+    cluster.add_deployment(deployment, replicas=1)
+    # let the first pod start before the pipeline begins
+    clock.advance(15.0)
+    pipeline = AutoscalingPipeline(cluster, deployment, **kw)
+    return pipeline
+
+
+def test_steady_low_load_stays_at_min():
+    pipeline = make_pipeline(lambda t: 20.0)
+    pipeline.run_for(300.0)
+    assert pipeline.replicas() == 1
+    assert pipeline.scale_history == []
+
+
+def test_north_star_scale_up_1_to_4_within_60s():
+    """BASELINE.md north star: load spike to 4x target -> 4 replicas within 60 s
+    of the metric crossing 40."""
+    spike_at = 100.0
+    pipeline = make_pipeline(step_load(spike_at, 20.0, 640.0))
+    pipeline.run_for(spike_at + 60.0)
+    assert pipeline.replicas() == 4
+    # crossing happens at the first post-spike sample; all scale events inside 60s
+    assert all(ts <= spike_at + 60.0 for ts, _, _ in pipeline.scale_history)
+    # and the pods actually started (chips were available)
+    assert pipeline.running() == 4
+
+
+def test_shared_load_converges_without_flapping():
+    """After scale-up the per-pod load drops; the loop must settle, not flap
+    (the reference documents flapping as a caveat, README.md:123)."""
+    pipeline = make_pipeline(step_load(50.0, 30.0, 120.0))
+    pipeline.run_for(600.0)
+    assert pipeline.replicas() == 3  # 120 / 3 = 40 per pod = on target
+    # no scale event after convergence window
+    late = [e for e in pipeline.scale_history if e[0] > 300.0]
+    assert late == []
+
+
+def test_scale_down_after_load_drops():
+    pipeline = make_pipeline(
+        lambda t: 640.0 if t < 200.0 else 10.0,
+        behavior=HPABehavior(
+            scale_down=ScalingRules(
+                stabilization_window_seconds=60.0,
+                policies=[ScalingPolicy("Percent", 100, 15.0)],
+            )
+        ),
+    )
+    pipeline.run_for(150.0)
+    assert pipeline.replicas() == 4
+    pipeline.run_for(350.0)
+    assert pipeline.replicas() == 1
+
+
+def test_slow_exporter_reproduces_reference_overshoot():
+    """With the reference's 10 s collection interval (dcgm-exporter.yaml:37) and
+    no step-bounding policy, a per-pod busy-loop load overshoots to max even
+    though one replica's worth of load only doubled — the defect of
+    README.md:123 reproduced in simulation."""
+    pipeline = make_pipeline(
+        step_load(60.0, 30.0, 90.0),
+        load_mode="per_pod",
+        exporter_sample_interval=10.0,
+        behavior=HPABehavior(scale_up=ScalingRules(), scale_down=ScalingRules()),
+    )
+    pipeline.run_for(300.0)
+    # per_pod mode: every replica reports 90 -> ratio stays 2.25 regardless of
+    # replica count -> driven to max; that is exactly the runaway the fix bounds.
+    assert pipeline.replicas() == 4
+
+
+def test_behavior_policy_bounds_overshoot():
+    """Same scenario with our shipped behavior (1 pod / 30 s): replicas climb
+    stepwise, giving the shared-load feedback time to act."""
+    pipeline = make_pipeline(
+        step_load(60.0, 30.0, 120.0),
+        behavior=HPABehavior(
+            scale_up=ScalingRules(policies=[ScalingPolicy("Pods", 1, 30.0)])
+        ),
+    )
+    pipeline.run_for(600.0)
+    assert pipeline.replicas() == 3  # converged, never hit 4
+    assert max(to for _, _, to in pipeline.scale_history) == 3
+
+
+def test_multichip_slice_pods():
+    """v5e multi-chip pods (SURVEY.md §7(c)): 4 chips per pod, hottest chip
+    represents the pod via max-by; scale 1->2 consumes 8 chips total."""
+    pipeline = make_pipeline(
+        step_load(50.0, 20.0, 200.0),
+        chips_per_pod=4,
+        max_replicas=2,
+    )
+    pipeline.run_for(200.0)
+    assert pipeline.replicas() == 2
+    assert pipeline.running() == 2
+    node = pipeline.cluster.nodes["tpu-node-0"]
+    assert len(node.allocations) == 8
+
+
+def test_capacity_starved_pod_stays_pending_and_metric_ignores_it():
+    """More replicas than chips: the extra pod stays Pending; the average only
+    covers running pods (inner-join semantics, SURVEY.md §3.2) so the loop
+    doesn't divide by phantom replicas."""
+    pipeline = make_pipeline(
+        step_load(10.0, 20.0, 800.0),
+        nodes=[("tpu-node-0", 2)],
+        max_replicas=4,
+    )
+    pipeline.run_for(300.0)
+    assert pipeline.replicas() == 4
+    assert pipeline.running() == 2
+    assert len(pipeline.cluster.deployment_pods("tpu-test")) == 4
+
+
+def test_multi_node_scrape_aggregates_across_nodes():
+    """DaemonSet-per-node exporters + node relabel (SURVEY.md §4 'multi-node is
+    tested only implicitly' — here it's explicit)."""
+    pipeline = make_pipeline(
+        step_load(50.0, 20.0, 640.0),
+        nodes=[("tpu-node-0", 1), ("tpu-node-1", 1), ("tpu-node-2", 1), ("tpu-node-3", 1)],
+    )
+    pipeline.run_for(300.0)
+    assert pipeline.replicas() == 4
+    assert pipeline.running() == 4
+    used_nodes = {p.node for p in pipeline.cluster.running_pods("tpu-test")}
+    assert len(used_nodes) == 4
+
+
+def test_exporter_outage_holds_replicas():
+    """Kill all exporter targets: staleness empties the recorded series, the
+    adapter returns None, the HPA holds — no scale-to-zero surprises."""
+    pipeline = make_pipeline(step_load(50.0, 20.0, 640.0))
+    pipeline.run_for(200.0)
+    assert pipeline.replicas() == 4
+    # sever every exporter target (keep kube-state-metrics)
+    for target in list(pipeline.scraper.targets):
+        if target.name.startswith("exporter/"):
+            target.fetch = _raise_down
+    pipeline.run_for(400.0)
+    assert pipeline.replicas() == 4
+    assert "unavailable" in pipeline.hpa.status.last_reason
+
+
+def _raise_down():
+    raise ConnectionError("exporter down")

@@ -1,0 +1,92 @@
+"""Exposition format round-trip and contract tests (the L2→L3 joint).
+
+Mirrors the reference's first smoke probe: curl the exporter and grep for a
+known metric name (README.md:42-47) — here done programmatically and in both
+directions (encode → parse)."""
+
+import math
+
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text, parse_text
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    CHIP_METRICS,
+    ChipSample,
+    MetricFamily,
+    Sample,
+    TPU_HBM_TOTAL,
+    TPU_TENSORCORE_UTIL,
+    families_from_chips,
+)
+
+
+def make_chip(index=0, util=55.0):
+    return ChipSample(
+        accel_index=index,
+        tensorcore_util=util,
+        duty_cycle=80.0,
+        hbm_usage_bytes=8.5e9,
+        hbm_total_bytes=16e9,
+        hbm_bw_util=30.0,
+    )
+
+
+def test_encode_contains_type_help_and_samples():
+    fams = families_from_chips([make_chip()], node="tpu-node-0")
+    text = encode_text(fams)
+    assert f"# TYPE {TPU_TENSORCORE_UTIL} gauge" in text
+    assert f"# HELP {TPU_TENSORCORE_UTIL}" in text
+    assert 'node="tpu-node-0"' in text
+    assert 'chip="0"' in text
+
+
+def test_roundtrip_preserves_values_and_labels():
+    attribution = {0: ("default", "tpu-test-abc"), 1: ("default", "tpu-test-def")}
+    fams = families_from_chips(
+        [make_chip(0, 42.5), make_chip(1, 99.0)], node="n1", attribution=attribution
+    )
+    parsed = {f.name: f for f in parse_text(encode_text(fams))}
+    assert set(parsed) == set(CHIP_METRICS)
+    util = parsed[TPU_TENSORCORE_UTIL]
+    by_chip = {s.label("chip"): s for s in util.samples}
+    assert by_chip["0"].value == 42.5
+    assert by_chip["0"].label("pod") == "tpu-test-abc"
+    assert by_chip["1"].value == 99.0
+    assert by_chip["1"].label("namespace") == "default"
+
+
+def test_unallocated_chip_gets_empty_pod_labels():
+    # dcgm-exporter behavior for devices not assigned to any pod.
+    fams = families_from_chips([make_chip(3)], node="n1", attribution={})
+    parsed = {f.name: f for f in parse_text(encode_text(fams))}
+    sample = parsed[TPU_TENSORCORE_UTIL].samples[0]
+    assert sample.label("pod") == ""
+    assert sample.label("namespace") == ""
+
+
+def test_label_value_escaping_roundtrip():
+    fam = MetricFamily("m", "gauge", "h")
+    fam.add(1.0, pod='we"ird\\pod\nname')
+    parsed = parse_text(encode_text([fam]))
+    assert parsed[0].samples[0].label("pod") == 'we"ird\\pod\nname'
+
+
+def test_special_float_values():
+    fam = MetricFamily("m", "gauge")
+    fam.add(float("nan"), chip="0")
+    fam.add(float("inf"), chip="1")
+    fam.add(16e9, chip="2")
+    parsed = parse_text(encode_text([fam]))[0]
+    by_chip = {s.label("chip"): s.value for s in parsed.samples}
+    assert math.isnan(by_chip["0"])
+    assert math.isinf(by_chip["1"])
+    assert by_chip["2"] == 16e9
+
+
+def test_parse_unlabeled_sample():
+    fams = parse_text("# TYPE up gauge\nup 1\n")
+    assert fams[0].samples == [Sample(1.0, ())]
+
+
+def test_hbm_total_is_bytes_scale():
+    fams = families_from_chips([make_chip()], node="n")
+    parsed = {f.name: f for f in parse_text(encode_text(fams))}
+    assert parsed[TPU_HBM_TOTAL].samples[0].value == 16e9

@@ -1,0 +1,210 @@
+"""HPA controller (L5) unit tests: the autoscaling/v2 algorithm with behavior.
+
+Covers the reference loop's semantics (desired = ceil(current*value/target),
+clamped to [min,max] — SURVEY.md §3.3) plus the ``behavior`` stabilization the
+reference names as the fix for its overshoot defect (README.md:123)."""
+
+from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPABehavior,
+    HPAController,
+    ObjectMetricSpec,
+    ScalingPolicy,
+    ScalingRules,
+)
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+RECORD = "tpu_test_tensorcore_avg"
+REF = ObjectReference("Deployment", "tpu-test", "default")
+LABELS = (("deployment", "tpu-test"), ("namespace", "default"))
+
+
+class FakeTarget:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+
+    def scale_to(self, replicas):
+        self.replicas = replicas
+
+
+def make_hpa(clock, db, target, **kw):
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series=RECORD)])
+    kw.setdefault("behavior", HPABehavior())
+    return HPAController(
+        target=target,
+        metrics=[ObjectMetricSpec(RECORD, 40.0, REF)],
+        adapter=adapter,
+        clock=clock,
+        min_replicas=1,
+        max_replicas=4,
+        **kw,
+    )
+
+
+def set_metric(db, value):
+    db.append(RECORD, LABELS, value)
+
+
+def test_core_formula_scale_up():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(1)
+    hpa = make_hpa(clock, db, target)
+    set_metric(db, 80.0)  # ratio 2.0 -> ceil(1*2) = 2
+    hpa.sync_once()
+    assert target.replicas == 2
+
+
+def test_within_tolerance_no_change():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(2)
+    hpa = make_hpa(clock, db, target)
+    set_metric(db, 42.0)  # ratio 1.05 < 1.1 tolerance
+    hpa.sync_once()
+    assert target.replicas == 2
+
+
+def test_clamped_to_max():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(2)
+    # behavior with no policy limits so the clamp is what binds
+    behavior = HPABehavior(scale_up=ScalingRules(), scale_down=ScalingRules())
+    hpa = make_hpa(clock, db, target, behavior=behavior)
+    set_metric(db, 400.0)  # ratio 10 -> 20, clamp to 4
+    hpa.sync_once()
+    assert target.replicas == 4
+
+
+def test_metric_unavailable_holds():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(3)
+    hpa = make_hpa(clock, db, target)
+    hpa.sync_once()  # no series at all
+    assert target.replicas == 3
+    assert "unavailable" in hpa.status.last_reason
+
+
+def test_scale_up_policy_bounds_step():
+    """Pods policy 1/60s: even with a huge ratio only one pod per minute is
+    added — the direct cure for overshoot-to-max (README.md:123)."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(1)
+    behavior = HPABehavior(
+        scale_up=ScalingRules(policies=[ScalingPolicy("Pods", 1, 60.0)]),
+    )
+    hpa = make_hpa(clock, db, target, behavior=behavior)
+    set_metric(db, 400.0)
+    hpa.sync_once()
+    assert target.replicas == 2  # not 4
+    clock.advance(15.0)
+    set_metric(db, 400.0)
+    hpa.sync_once()
+    assert target.replicas == 2  # still inside the 60s period
+    clock.advance(50.0)
+    set_metric(db, 400.0)
+    hpa.sync_once()
+    assert target.replicas == 3
+
+
+def test_scale_down_stabilization_window():
+    """A transient dip must not shed replicas: scale-down takes the max
+    recommendation over the window."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(4)
+    behavior = HPABehavior(
+        scale_down=ScalingRules(
+            stabilization_window_seconds=60.0,
+            policies=[ScalingPolicy("Percent", 100, 15.0)],
+        )
+    )
+    hpa = make_hpa(clock, db, target, behavior=behavior)
+    set_metric(db, 45.0)  # high -> keep 4 (recommendation 4... ratio 1.125 -> 5 clamp 4)
+    hpa.sync_once()
+    clock.advance(15.0)
+    set_metric(db, 5.0)  # dip -> raw recommendation 1
+    hpa.sync_once()
+    assert target.replicas == 4  # held by the window
+    # dip persists past the window -> now allowed to drop
+    for _ in range(5):
+        clock.advance(15.0)
+        set_metric(db, 5.0)
+        hpa.sync_once()
+    assert target.replicas < 4
+
+
+def test_scale_down_disabled_policy():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(3)
+    behavior = HPABehavior(scale_down=ScalingRules(select_policy="Disabled"))
+    hpa = make_hpa(clock, db, target, behavior=behavior)
+    set_metric(db, 1.0)
+    hpa.sync_once()
+    assert target.replicas == 3
+
+
+def test_multiple_metrics_takes_max_proposal():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(1)
+    adapter = CustomMetricsAdapter(
+        db, [AdapterRule(series=RECORD), AdapterRule(series="tpu_test_hbm_bw_avg")]
+    )
+    hpa = HPAController(
+        target=target,
+        metrics=[
+            ObjectMetricSpec(RECORD, 40.0, REF),
+            ObjectMetricSpec("tpu_test_hbm_bw_avg", 40.0, REF),
+        ],
+        adapter=adapter,
+        clock=clock,
+        min_replicas=1,
+        max_replicas=4,
+    )
+    set_metric(db, 10.0)  # proposes 1
+    db.append("tpu_test_hbm_bw_avg", LABELS, 120.0)  # proposes 3
+    hpa.sync_once()
+    assert target.replicas == 3
+
+
+def test_percent_policy_uses_period_start_replicas():
+    """Percent 100%/60s from base 1: repeated syncs inside one period cannot
+    compound (1->2, then still limited to 2 until the period rolls)."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    target = FakeTarget(1)
+    behavior = HPABehavior(
+        scale_up=ScalingRules(policies=[ScalingPolicy("Percent", 100, 60.0)])
+    )
+    hpa = make_hpa(clock, db, target, behavior=behavior)
+    set_metric(db, 400.0)
+    hpa.sync_once()
+    assert target.replicas == 2
+    clock.advance(15.0)
+    set_metric(db, 400.0)
+    hpa.sync_once()
+    assert target.replicas == 2
+
+
+def test_adapter_lists_available_metrics():
+    db = TimeSeriesDB(VirtualClock())
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series=RECORD)])
+    assert adapter.list_metrics() == []
+    set_metric(db, 10.0)
+    assert adapter.list_metrics() == [RECORD]
+    assert adapter.get_object_metric(REF, RECORD) == 10.0
+
+
+def test_adapter_wrong_object_returns_none():
+    db = TimeSeriesDB(VirtualClock())
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series=RECORD)])
+    set_metric(db, 10.0)
+    other = ObjectReference("Deployment", "another-app", "default")
+    assert adapter.get_object_metric(other, RECORD) is None
+    assert adapter.get_object_metric(REF, "unknown_metric") is None

@@ -1,0 +1,192 @@
+"""TSDB scrape/staleness semantics and the recording-rule aggregation (L3).
+
+The rule tests reproduce the reference's manual Prometheus probe
+(``curl .../api/v1/query?query=cuda_test_gpu_avg``, README.md:80-88) against the
+in-process engine, covering the three load-bearing behaviors of
+cuda-test-prometheusrule.yaml:13: max-by-pod collapse, the kube_pod_labels
+app-scoping join, and cross-replica averaging."""
+
+import pytest
+
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    MetricFamily,
+    TPU_TENSORCORE_UTIL,
+    families_from_chips,
+)
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    Avg,
+    MaxBy,
+    MulOnGroupLeft,
+    RecordingRule,
+    RuleEvaluator,
+    Select,
+    tpu_test_avg_rule,
+)
+from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+from tests.test_exposition import make_chip
+
+
+def lbl(**kw):
+    return tuple(sorted(kw.items()))
+
+
+def seed_pod(db, pod, utils, node="n0", app="tpu-test", namespace="default"):
+    """One pod with one util sample per chip, plus its kube_pod_labels row."""
+    for chip, util in enumerate(utils):
+        db.append(
+            TPU_TENSORCORE_UTIL,
+            lbl(node=node, pod=pod, namespace=namespace, chip=str(chip)),
+            util,
+        )
+    db.append("kube_pod_labels", lbl(pod=pod, label_app=app, namespace=namespace), 1.0)
+
+
+def test_scraper_attaches_target_labels():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    scraper = Scraper(db)
+    fams = families_from_chips([make_chip(0, 50.0)], node="ignored")
+    scraper.add_target(lambda: encode_text(fams), node="tpu-node-7")
+    assert scraper.scrape_once() > 0
+    vec = db.instant_vector(TPU_TENSORCORE_UTIL)
+    # target label overrides the exposition's node label (relabel semantics,
+    # kube-prometheus-stack-values.yaml:13-16)
+    assert vec[0].label("node") == "tpu-node-7"
+
+
+def test_scraper_survives_down_target():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    scraper = Scraper(db)
+
+    def dead():
+        raise ConnectionError("target down")
+
+    t = scraper.add_target(dead)
+    good = MetricFamily("up_metric", "gauge")
+    good.add(1.0, chip="0")
+    scraper.add_target(lambda: encode_text([good]))
+    assert scraper.scrape_once() == 1
+    assert not t.healthy
+
+
+def test_staleness_window_drops_old_points():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock, lookback=300.0)
+    db.append("m", lbl(pod="p"), 5.0)
+    clock.advance(299.0)
+    assert db.latest("m", {"pod": "p"}) == 5.0
+    clock.advance(2.0)
+    assert db.latest("m", {"pod": "p"}) is None
+
+
+def test_latest_raises_on_ambiguous_match():
+    db = TimeSeriesDB(VirtualClock())
+    db.append("m", lbl(pod="a"), 1.0)
+    db.append("m", lbl(pod="b"), 2.0)
+    with pytest.raises(ValueError):
+        db.latest("m")
+
+
+def test_max_by_collapses_chips_within_pod():
+    db = TimeSeriesDB(VirtualClock())
+    seed_pod(db, "p0", [10.0, 90.0, 40.0, 20.0])  # 4-chip slice pod
+    vec = MaxBy(("node", "pod", "namespace"), Select(TPU_TENSORCORE_UTIL)).evaluate(db)
+    assert len(vec) == 1
+    assert vec[0].value == 90.0
+    assert vec[0].label("pod") == "p0"
+
+
+def test_join_filters_foreign_apps():
+    db = TimeSeriesDB(VirtualClock())
+    seed_pod(db, "mine", [60.0])
+    seed_pod(db, "other", [99.0], app="someone-else")
+    rule = tpu_test_avg_rule()
+    rule.evaluate_into(db)
+    # only the tpu-test pod contributes: avg == 60, not (60+99)/2
+    assert db.latest("tpu_test_tensorcore_avg") == 60.0
+
+
+def test_avg_across_replicas():
+    db = TimeSeriesDB(VirtualClock())
+    seed_pod(db, "p0", [40.0, 80.0])  # max 80
+    seed_pod(db, "p1", [20.0])  # max 20
+    tpu_test_avg_rule().evaluate_into(db)
+    assert db.latest("tpu_test_tensorcore_avg") == 50.0
+
+
+def test_recorded_series_carries_static_labels():
+    db = TimeSeriesDB(VirtualClock())
+    seed_pod(db, "p0", [30.0])
+    tpu_test_avg_rule().evaluate_into(db)
+    vec = db.instant_vector("tpu_test_tensorcore_avg")
+    labels = dict(vec[0].labels)
+    # the labels prometheus-adapter uses to bind the series to the Deployment
+    # object (cuda-test-prometheusrule.yaml:14-16)
+    assert labels == {"namespace": "default", "deployment": "tpu-test"}
+
+
+def test_no_output_when_no_matching_pods():
+    db = TimeSeriesDB(VirtualClock())
+    seed_pod(db, "other", [50.0], app="unrelated")
+    assert tpu_test_avg_rule().evaluate_into(db) == 0
+    assert db.instant_vector("tpu_test_tensorcore_avg") == []
+
+
+def test_many_to_many_join_rejected():
+    db = TimeSeriesDB(VirtualClock())
+    expr = MulOnGroupLeft(
+        left=Select("left_m"),
+        right=Select("right_m"),
+        on=("pod",),
+    )
+    db.append("left_m", lbl(pod="p"), 1.0)
+    db.append("right_m", lbl(pod="p", x="1"), 1.0)
+    db.append("right_m", lbl(pod="p", x="2"), 1.0)
+    with pytest.raises(ValueError):
+        expr.evaluate(db)
+
+
+def test_rule_evaluator_reevaluates_over_time():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    evaluator = RuleEvaluator(db, [tpu_test_avg_rule()])
+    seed_pod(db, "p0", [10.0])
+    evaluator.evaluate_once()
+    assert db.latest("tpu_test_tensorcore_avg") == 10.0
+    clock.advance(5.0)
+    seed_pod(db, "p0", [70.0])
+    evaluator.evaluate_once()
+    assert db.latest("tpu_test_tensorcore_avg") == 70.0
+
+
+def test_promql_rendering_matches_reference_shape():
+    """The generated PromQL must have the same shape as
+    cuda-test-prometheusrule.yaml:13 with TPU names substituted."""
+    rule = tpu_test_avg_rule()
+    q = rule.expr.promql()
+    assert q == (
+        "avg(max by(node,pod,namespace)(tpu_tensorcore_utilization) "
+        "* on(pod) group_left(label_app) "
+        'max by(pod,label_app)(kube_pod_labels{label_app="tpu-test"}))'
+    )
+
+
+def test_multi_metric_rule_shapes():
+    """BASELINE configs[3]: multi-metric HPA needs per-metric recorded series."""
+    from k8s_gpu_hpa_tpu.metrics.schema import TPU_DUTY_CYCLE, TPU_HBM_BW_UTIL
+
+    db = TimeSeriesDB(VirtualClock())
+    seed_pod(db, "p0", [50.0])
+    db.append("kube_pod_labels", lbl(pod="p0", label_app="tpu-test", namespace="default"), 1.0)
+    for metric, record in [
+        (TPU_DUTY_CYCLE, "tpu_test_duty_cycle_avg"),
+        (TPU_HBM_BW_UTIL, "tpu_test_hbm_bw_avg"),
+    ]:
+        db.append(metric, lbl(node="n0", pod="p0", namespace="default", chip="0"), 33.0)
+        rule = tpu_test_avg_rule(metric=metric, record=record)
+        rule.evaluate_into(db)
+        assert db.latest(record) == 33.0
