@@ -1,0 +1,105 @@
+// Standalone tpu-metrics-exporter binary (DaemonSet entrypoint).
+//
+// Flag surface mirrors dcgm-exporter's (dcgm-exporter.yaml:30-37):
+//   --listen ADDR:PORT   (DCGM_EXPORTER_LISTEN, default :9400)
+//   --node NAME          (node name stamped on samples; Downward-API in k8s)
+//   --collect-ms N       (the -c collection interval; default 1000 — the
+//                         reference's 10000 is its documented lag defect,
+//                         README.md:123)
+//   --source stub|stdin  (chip readings source; the production libtpu gRPC
+//                         reader runs in the Python daemon and feeds the
+//                         library ABI instead of this binary)
+//
+// `--source stub` serves a synthetic utilization curve (demo/smoke-test mode,
+// the analog of running the reference's curl probe README.md:42-47 without
+// hardware).  `--source stdin` reads "accel_index util duty hbm_used hbm_total
+// bw" lines, one sweep per blank line — lets any process feed it.
+
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tpu_exporter.h"
+
+int main(int argc, char** argv) {
+  std::string listen = ":9400";
+  std::string node = "unknown-node";
+  std::string source = "stub";
+  long collect_ms = 1000;
+  for (int i = 1; i < argc; ++i) {
+    auto need = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s requires a value\n", flag);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (!strcmp(argv[i], "--listen")) listen = need("--listen");
+    else if (!strcmp(argv[i], "--node")) node = need("--node");
+    else if (!strcmp(argv[i], "--collect-ms")) collect_ms = atol(need("--collect-ms"));
+    else if (!strcmp(argv[i], "--source")) source = need("--source");
+    else {
+      fprintf(stderr,
+              "usage: tpu-metrics-exporter [--listen ADDR:PORT] [--node NAME] "
+              "[--collect-ms N] [--source stub|stdin]\n");
+      return 2;
+    }
+  }
+
+  std::string addr = "0.0.0.0";
+  int port = 9400;
+  auto colon = listen.rfind(':');
+  if (colon != std::string::npos) {
+    if (colon > 0) addr = listen.substr(0, colon);
+    port = atoi(listen.c_str() + colon + 1);
+  }
+
+  TpuExporter* ex =
+      tpu_exporter_create(node.c_str(), addr.c_str(), port, 3 * collect_ms);
+  if (!ex) {
+    fprintf(stderr, "failed to bind %s:%d\n", addr.c_str(), port);
+    return 1;
+  }
+  fprintf(stderr, "tpu-metrics-exporter serving on %s:%d (node=%s, source=%s)\n",
+          addr.c_str(), tpu_exporter_port(ex), node.c_str(), source.c_str());
+
+  if (source == "stub") {
+    double t = 0;
+    while (true) {
+      std::vector<TpuChipSample> chips;
+      for (int i = 0; i < 4; ++i) {
+        double util = 50.0 + 45.0 * std::sin(t / 30.0 + i);
+        chips.push_back(TpuChipSample{i, util, std::fmin(100.0, util * 1.1),
+                                      0.5e9 + 15.5e9 * util / 100.0, 16e9,
+                                      util * 0.6});
+      }
+      tpu_exporter_push_samples(ex, chips.data(), (int32_t)chips.size());
+      usleep(static_cast<useconds_t>(collect_ms) * 1000);
+      t += collect_ms / 1000.0;
+    }
+  } else {  // stdin
+    std::vector<TpuChipSample> chips;
+    char line[256];
+    while (fgets(line, sizeof(line), stdin)) {
+      TpuChipSample s{};
+      if (sscanf(line, "%d %lf %lf %lf %lf %lf", &s.accel_index,
+                 &s.tensorcore_util, &s.duty_cycle, &s.hbm_usage_bytes,
+                 &s.hbm_total_bytes, &s.hbm_bw_util) == 6) {
+        chips.push_back(s);
+      } else if (!chips.empty()) {  // blank/invalid line flushes the sweep
+        tpu_exporter_push_samples(ex, chips.data(), (int32_t)chips.size());
+        chips.clear();
+      }
+    }
+    if (!chips.empty())
+      tpu_exporter_push_samples(ex, chips.data(), (int32_t)chips.size());
+    pause();  // keep serving after stdin closes
+  }
+  tpu_exporter_destroy(ex);
+  return 0;
+}

@@ -1,0 +1,329 @@
+// tpu-metrics-exporter native core: metric registry, Prometheus text renderer,
+// and HTTP /metrics server.  See tpu_exporter.h for the role description.
+
+#include "tpu_exporter.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Escape a label value per the Prometheus text exposition spec: \, ", \n.
+std::string EscapeLabel(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    switch (c) {
+      case '\\': out += "\\\\"; break;
+      case '"': out += "\\\""; break;
+      case '\n': out += "\\n"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+// Format a double the way the Python reference encoder does: integers without
+// a fraction, otherwise shortest round-trip representation.  The magnitude
+// guard must precede the int64 cast: casting a double outside int64 range is UB.
+std::string FormatValue(double v) {
+  if (std::isnan(v)) return "NaN";
+  if (std::isinf(v)) return v > 0 ? "+Inf" : "-Inf";
+  if (std::fabs(v) < 1e15 && v == static_cast<int64_t>(v)) {
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(v));
+    return buf;
+  }
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%.17g", v);
+  // Trim to shortest representation that round-trips.
+  for (int prec = 1; prec < 17; ++prec) {
+    char shorter[64];
+    snprintf(shorter, sizeof(shorter), "%.*g", prec, v);
+    if (strtod(shorter, nullptr) == v) return shorter;
+  }
+  return buf;
+}
+
+struct MetricDef {
+  const char* name;
+  const char* help;
+};
+
+// Order and metadata mirror k8s_gpu_hpa_tpu/metrics/schema.py::CHIP_METRICS.
+constexpr MetricDef kChipMetrics[] = {
+    {"tpu_tensorcore_utilization", "TensorCore utilization percent per TPU chip"},
+    {"tpu_duty_cycle", "Accelerator duty cycle percent per TPU chip"},
+    {"tpu_hbm_memory_usage_bytes", "HBM memory used in bytes per TPU chip"},
+    {"tpu_hbm_memory_total_bytes", "Total HBM memory in bytes per TPU chip"},
+    {"tpu_hbm_memory_bandwidth_utilization",
+     "HBM bandwidth utilization percent per TPU chip"},
+};
+
+double MetricValue(const TpuChipSample& s, int metric_idx) {
+  switch (metric_idx) {
+    case 0: return s.tensorcore_util;
+    case 1: return s.duty_cycle;
+    case 2: return s.hbm_usage_bytes;
+    case 3: return s.hbm_total_bytes;
+    case 4: return s.hbm_bw_util;
+  }
+  return 0.0;
+}
+
+}  // namespace
+
+struct TpuExporter {
+  std::string node_name;
+  int64_t staleness_ms;
+
+  std::mutex mu;
+  std::vector<TpuChipSample> samples;               // guarded by mu
+  std::map<int32_t, std::pair<std::string, std::string>> attribution;  // mu
+  int64_t last_push_ms = -1;                        // guarded by mu
+
+  std::atomic<uint64_t> request_count{0};
+  std::atomic<bool> shutdown{false};
+  int listen_fd = -1;
+  int bound_port = -1;
+  std::thread server_thread;
+
+  std::string Render() {
+    std::lock_guard<std::mutex> lock(mu);
+    int64_t now = NowMs();
+    bool fresh = last_push_ms >= 0 && now - last_push_ms <= staleness_ms;
+    std::string out;
+    out.reserve(4096);
+
+    // Exporter self-metrics first: liveness and sample age are part of the
+    // contract (lets the scrape side distinguish "no load" from "no data").
+    out += "# HELP tpu_metrics_exporter_up 1 if chip readings are fresh\n";
+    out += "# TYPE tpu_metrics_exporter_up gauge\n";
+    out += "tpu_metrics_exporter_up{node=\"" + EscapeLabel(node_name) + "\"} ";
+    out += fresh ? "1\n" : "0\n";
+    if (last_push_ms >= 0) {
+      out += "# HELP tpu_metrics_exporter_sample_age_seconds age of newest chip reading\n";
+      out += "# TYPE tpu_metrics_exporter_sample_age_seconds gauge\n";
+      out += "tpu_metrics_exporter_sample_age_seconds{node=\"" +
+             EscapeLabel(node_name) + "\"} " +
+             FormatValue(static_cast<double>(now - last_push_ms) / 1000.0) + "\n";
+    }
+    if (!fresh) return out;  // withhold stale chip gauges entirely
+
+    for (int m = 0; m < 5; ++m) {
+      out += "# HELP ";
+      out += kChipMetrics[m].name;
+      out += " ";
+      out += kChipMetrics[m].help;
+      out += "\n# TYPE ";
+      out += kChipMetrics[m].name;
+      out += " gauge\n";
+      for (const TpuChipSample& s : samples) {
+        std::string ns, pod;
+        auto it = attribution.find(s.accel_index);
+        if (it != attribution.end()) {
+          ns = it->second.first;
+          pod = it->second.second;
+        }
+        out += kChipMetrics[m].name;
+        out += "{chip=\"" + std::to_string(s.accel_index) + "\"";
+        out += ",namespace=\"" + EscapeLabel(ns) + "\"";
+        out += ",node=\"" + EscapeLabel(node_name) + "\"";
+        out += ",pod=\"" + EscapeLabel(pod) + "\"} ";
+        out += FormatValue(MetricValue(s, m));
+        out += "\n";
+      }
+    }
+    return out;
+  }
+
+  void HandleConnection(int fd) {
+    // Minimal HTTP/1.1: read the request head, answer GET /metrics | /healthz.
+    // Connections are served inline on the acceptor thread, so a stuck peer
+    // must never block forever: bound both directions with socket timeouts.
+    timeval timeout{2, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &timeout, sizeof(timeout));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &timeout, sizeof(timeout));
+    char buf[4096];
+    ssize_t n = recv(fd, buf, sizeof(buf) - 1, 0);
+    if (n <= 0) {
+      close(fd);
+      return;
+    }
+    buf[n] = '\0';
+    request_count.fetch_add(1, std::memory_order_relaxed);
+
+    std::string body;
+    std::string status = "200 OK";
+    std::string content_type = "text/plain; version=0.0.4; charset=utf-8";
+    if (strncmp(buf, "GET /metrics", 12) == 0) {
+      body = Render();
+    } else if (strncmp(buf, "GET /healthz", 12) == 0) {
+      body = "ok\n";
+      content_type = "text/plain";
+    } else if (strncmp(buf, "GET ", 4) == 0) {
+      status = "404 Not Found";
+      body = "not found\n";
+      content_type = "text/plain";
+    } else {
+      status = "405 Method Not Allowed";
+      body = "method not allowed\n";
+      content_type = "text/plain";
+    }
+    std::string resp = "HTTP/1.1 " + status +
+                       "\r\nContent-Type: " + content_type +
+                       "\r\nContent-Length: " + std::to_string(body.size()) +
+                       "\r\nConnection: close\r\n\r\n" + body;
+    size_t off = 0;
+    while (off < resp.size()) {
+      ssize_t w = send(fd, resp.data() + off, resp.size() - off, MSG_NOSIGNAL);
+      if (w <= 0) break;
+      off += static_cast<size_t>(w);
+    }
+    close(fd);
+  }
+
+  void ServeLoop() {
+    while (!shutdown.load(std::memory_order_acquire)) {
+      sockaddr_in peer{};
+      socklen_t peer_len = sizeof(peer);
+      int fd = accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &peer_len);
+      if (fd < 0) {
+        if (shutdown.load(std::memory_order_acquire)) break;
+        continue;
+      }
+      // Scrape handling is cheap (one render); serve inline rather than
+      // spawning per-connection threads — Prometheus scrapes serially, and
+      // the per-connection socket timeouts bound how long a bad peer can
+      // hold the acceptor.
+      HandleConnection(fd);
+    }
+  }
+
+  bool StartServer(const char* addr, int32_t port) {
+    listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
+      close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    if (bind(listen_fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+        listen(listen_fd, 16) != 0) {
+      close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+    bound_port = ntohs(bound.sin_port);
+    server_thread = std::thread([this] { ServeLoop(); });
+    return true;
+  }
+
+  void StopServer() {
+    shutdown.store(true, std::memory_order_release);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      close(listen_fd);
+      listen_fd = -1;
+    }
+    if (server_thread.joinable()) server_thread.join();
+  }
+};
+
+extern "C" {
+
+TpuExporter* tpu_exporter_create(const char* node_name, const char* listen_addr,
+                                 int32_t port, int64_t staleness_ms) {
+  auto* ex = new TpuExporter();
+  ex->node_name = node_name ? node_name : "";
+  ex->staleness_ms = staleness_ms > 0 ? staleness_ms : 10000;
+  if (port >= 0) {
+    if (!ex->StartServer(listen_addr ? listen_addr : "0.0.0.0", port)) {
+      delete ex;
+      return nullptr;
+    }
+  }
+  return ex;
+}
+
+void tpu_exporter_destroy(TpuExporter* ex) {
+  if (!ex) return;
+  ex->StopServer();
+  delete ex;
+}
+
+void tpu_exporter_push_samples(TpuExporter* ex, const TpuChipSample* samples,
+                               int32_t n) {
+  std::lock_guard<std::mutex> lock(ex->mu);
+  ex->samples.assign(samples, samples + (n > 0 ? n : 0));
+  ex->last_push_ms = NowMs();
+}
+
+void tpu_exporter_set_attribution(TpuExporter* ex, int32_t accel_index,
+                                  const char* ns, const char* pod) {
+  std::lock_guard<std::mutex> lock(ex->mu);
+  ex->attribution[accel_index] = {ns ? ns : "", pod ? pod : ""};
+}
+
+void tpu_exporter_clear_attribution(TpuExporter* ex) {
+  std::lock_guard<std::mutex> lock(ex->mu);
+  ex->attribution.clear();
+}
+
+void tpu_exporter_replace_attribution(TpuExporter* ex, const int32_t* indices,
+                                      const char* const* namespaces,
+                                      const char* const* pods, int32_t n) {
+  // Build outside the lock, swap under it.
+  std::map<int32_t, std::pair<std::string, std::string>> next;
+  for (int32_t i = 0; i < n; ++i) {
+    next[indices[i]] = {namespaces[i] ? namespaces[i] : "",
+                        pods[i] ? pods[i] : ""};
+  }
+  std::lock_guard<std::mutex> lock(ex->mu);
+  ex->attribution.swap(next);
+}
+
+int64_t tpu_exporter_render(TpuExporter* ex, char* buf, int64_t buflen) {
+  std::string out = ex->Render();
+  int64_t needed = static_cast<int64_t>(out.size());
+  if (buflen < needed + 1) return -(needed + 1);
+  memcpy(buf, out.data(), out.size());
+  buf[needed] = '\0';
+  return needed;
+}
+
+int32_t tpu_exporter_port(const TpuExporter* ex) { return ex->bound_port; }
+
+uint64_t tpu_exporter_request_count(const TpuExporter* ex) {
+  return ex->request_count.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"

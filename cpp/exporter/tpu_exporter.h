@@ -1,0 +1,88 @@
+// tpu-metrics-exporter native core — C ABI.
+//
+// TPU-native analog of NVIDIA's DCGM + dcgm-exporter (the one genuinely native
+// component the reference pulls as an image: dcgm-exporter.yaml:29, SURVEY.md
+// §2b).  The core owns the hot path: the per-chip metric registry, Prometheus
+// text rendering, and the HTTP /metrics endpoint (the reference serves :9400,
+// dcgm-exporter.yaml:31-32,40-41).  Metric *acquisition* is pushed in through
+// this ABI by the host process — on a GKE TPU node that host is the Python
+// daemon speaking gRPC to the libtpu runtime-metrics service (localhost:8431)
+// and to the kubelet PodResources socket for chip→pod attribution
+// (dcgm-exporter's equivalent mounts: dcgm-exporter.yaml:50-62); in tests it is
+// a stub source, which is what gives the exporter the hardware-free test story
+// the reference lacks (SURVEY.md §4).
+//
+// Thread-safety: all functions are safe to call concurrently.  The HTTP server
+// runs one acceptor thread that serves each connection inline (Prometheus
+// scrapes serially; renders are cheap); per-connection socket timeouts bound
+// how long a misbehaving peer can occupy the acceptor.
+
+#ifndef TPU_EXPORTER_H_
+#define TPU_EXPORTER_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct TpuExporter TpuExporter;
+
+// One reading of every per-chip gauge (schema mirror of
+// k8s_gpu_hpa_tpu/metrics/schema.py::ChipSample).
+typedef struct {
+  int32_t accel_index;
+  double tensorcore_util;   // percent 0-100
+  double duty_cycle;        // percent 0-100
+  double hbm_usage_bytes;
+  double hbm_total_bytes;
+  double hbm_bw_util;       // percent 0-100
+} TpuChipSample;
+
+// Create an exporter. `node_name` is stamped on every sample (the analog of the
+// reference's node relabel, kube-prometheus-stack-values.yaml:13-16, done at
+// the source here so even a raw curl shows the node).  `listen_addr` e.g.
+// "0.0.0.0" for a DaemonSet or "127.0.0.1" for tests; `port` 0 picks an
+// ephemeral port; port -1 disables the HTTP server (render-only mode).
+// `staleness_ms`: if no push arrives within this window, /metrics reports
+// tpu_metrics_exporter_up 0 and withholds chip samples rather than serving
+// frozen values (the reference's 10 s collection lag, dcgm-exporter.yaml:37,
+// served stale data silently — this is the fix).
+TpuExporter* tpu_exporter_create(const char* node_name, const char* listen_addr,
+                                 int32_t port, int64_t staleness_ms);
+
+void tpu_exporter_destroy(TpuExporter* ex);
+
+// Replace the current chip readings (one full sweep per call).
+void tpu_exporter_push_samples(TpuExporter* ex, const TpuChipSample* samples,
+                               int32_t n);
+
+// Set chip→pod attribution; chips without an entry export empty pod labels
+// (dcgm-exporter behavior for unallocated devices).
+void tpu_exporter_set_attribution(TpuExporter* ex, int32_t accel_index,
+                                  const char* ns, const char* pod);
+void tpu_exporter_clear_attribution(TpuExporter* ex);
+
+// Atomically replace the whole attribution table (parallel arrays of length n).
+// A concurrent scrape sees either the old or the new mapping, never a partial
+// one — use this for the periodic refresh, not clear+set loops.
+void tpu_exporter_replace_attribution(TpuExporter* ex, const int32_t* indices,
+                                      const char* const* namespaces,
+                                      const char* const* pods, int32_t n);
+
+// Render the Prometheus text exposition into buf.  Returns the number of bytes
+// written (excluding the NUL terminator), or the negative required size if
+// buflen is too small.
+int64_t tpu_exporter_render(TpuExporter* ex, char* buf, int64_t buflen);
+
+// Actual bound port of the HTTP server (useful with port 0), or -1 if disabled.
+int32_t tpu_exporter_port(const TpuExporter* ex);
+
+// Number of HTTP requests served (observability + test hook).
+uint64_t tpu_exporter_request_count(const TpuExporter* ex);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // TPU_EXPORTER_H_

@@ -1,1 +1,22 @@
-"""Placeholder: populated by the exporter milestone (see package docstring)."""
+from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+from k8s_gpu_hpa_tpu.exporter.native import NativeExporter, build_native
+from k8s_gpu_hpa_tpu.exporter.podresources import (
+    PodResourcesClient,
+    StaticAttributor,
+    parse_device_index,
+    parse_list_response,
+)
+from k8s_gpu_hpa_tpu.exporter.sources import JaxDeviceSource, LibtpuSource, StubSource
+
+__all__ = [
+    "ExporterDaemon",
+    "NativeExporter",
+    "build_native",
+    "PodResourcesClient",
+    "StaticAttributor",
+    "parse_device_index",
+    "parse_list_response",
+    "JaxDeviceSource",
+    "LibtpuSource",
+    "StubSource",
+]

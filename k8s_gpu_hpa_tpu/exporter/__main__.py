@@ -1,0 +1,5 @@
+"""Entrypoint: ``python -m k8s_gpu_hpa_tpu.exporter`` (DaemonSet container cmd)."""
+
+from k8s_gpu_hpa_tpu.exporter.daemon import main
+
+main()

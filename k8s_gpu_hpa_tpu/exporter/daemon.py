@@ -1,0 +1,120 @@
+"""The tpu-metrics-exporter daemon loop: source → native core → /metrics.
+
+Pulls chip readings from a MetricsSource every ``collect_interval`` (the analog
+of dcgm-exporter's ``-c`` flag, dcgm-exporter.yaml:37 — default 1 s here, not
+the reference's 10 s, because metric freshness bounds the whole control loop's
+latency, SURVEY.md §3.1 and §7(b)), refreshes chip→pod attribution at a lower
+rate (allocations change only on pod churn), and pushes both into the C++ core,
+which serves /metrics.
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_hpa_tpu.exporter.native import NativeExporter
+from k8s_gpu_hpa_tpu.exporter.podresources import Attributor
+from k8s_gpu_hpa_tpu.exporter.sources import MetricsSource
+from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
+
+
+class ExporterDaemon:
+    def __init__(
+        self,
+        source: MetricsSource,
+        attributor: Attributor | None = None,
+        node_name: str = "unknown-node",
+        listen_addr: str = "0.0.0.0",
+        port: int = 9400,
+        collect_interval: float = 1.0,
+        attribution_interval: float = 10.0,
+        clock: Clock | None = None,
+    ):
+        self.source = source
+        self.attributor = attributor
+        self.collect_interval = collect_interval
+        self.attribution_interval = attribution_interval
+        self.clock = clock or SystemClock()
+        self.native = NativeExporter(
+            node_name=node_name,
+            listen_addr=listen_addr,
+            port=port,
+            # up goes 0 after 3 missed collections, like dcgm watchdogs
+            staleness_ms=int(collect_interval * 3000),
+        )
+        self._last_attribution = -float("inf")
+        self.sweeps = 0
+
+    @property
+    def port(self) -> int:
+        return self.native.port
+
+    def step(self) -> None:
+        """One collection sweep (tests call this directly)."""
+        now = self.clock.now()
+        if (
+            self.attributor is not None
+            and now - self._last_attribution >= self.attribution_interval
+        ):
+            try:
+                self.native.set_attribution(self.attributor.list_allocations())
+                self._last_attribution = now
+            except Exception:
+                pass  # kubelet briefly unavailable: keep last mapping
+        try:
+            self.native.push(self.source.sample())
+            self.sweeps += 1
+        except Exception:
+            pass  # source hiccup: freshness watchdog flips `up` to 0
+
+    def run_forever(self) -> None:
+        while True:
+            self.step()
+            self.clock.sleep(self.collect_interval)
+
+    def close(self) -> None:
+        self.native.close()
+
+    def __enter__(self) -> "ExporterDaemon":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def main() -> None:
+    """CLI entrypoint: ``python -m k8s_gpu_hpa_tpu.exporter.daemon``.
+
+    Env-driven like the reference's container (dcgm-exporter.yaml:30-37):
+    NODE_NAME (Downward API), LISTEN_PORT, COLLECT_MS, SOURCE=stub|jax|libtpu.
+    """
+    import os
+
+    source_kind = os.environ.get("SOURCE", "libtpu")
+    if source_kind == "stub":
+        from k8s_gpu_hpa_tpu.exporter.sources import StubSource
+
+        source: MetricsSource = StubSource()
+        attributor = None
+    elif source_kind == "jax":
+        from k8s_gpu_hpa_tpu.exporter.sources import JaxDeviceSource
+
+        source = JaxDeviceSource()
+        attributor = None
+    else:
+        from k8s_gpu_hpa_tpu.exporter.podresources import PodResourcesClient
+        from k8s_gpu_hpa_tpu.exporter.sources import LibtpuSource
+
+        source = LibtpuSource()
+        attributor = PodResourcesClient()
+
+    daemon = ExporterDaemon(
+        source,
+        attributor=attributor,
+        node_name=os.environ.get("NODE_NAME", "unknown-node"),
+        port=int(os.environ.get("LISTEN_PORT", "9400")),
+        collect_interval=float(os.environ.get("COLLECT_MS", "1000")) / 1000.0,
+    )
+    daemon.run_forever()
+
+
+if __name__ == "__main__":
+    main()

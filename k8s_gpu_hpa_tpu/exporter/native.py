@@ -1,0 +1,167 @@
+"""ctypes binding to the native exporter core (cpp/exporter).
+
+The C++ library owns the serving hot path (registry, text rendering, HTTP);
+Python owns cluster-facing acquisition (libtpu gRPC, kubelet PodResources) and
+pushes sweeps through this binding — the same split as DCGM (C/C++) under
+dcgm-exporter (Go shell), SURVEY.md §2b, with the shells swapped.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+from k8s_gpu_hpa_tpu.metrics.schema import ChipSample
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_BUILD_DIR = _REPO_ROOT / "cpp" / "build"
+_LIB_PATH = _BUILD_DIR / "libtpu_exporter.so"
+
+
+class _CChipSample(ctypes.Structure):
+    _fields_ = [
+        ("accel_index", ctypes.c_int32),
+        ("tensorcore_util", ctypes.c_double),
+        ("duty_cycle", ctypes.c_double),
+        ("hbm_usage_bytes", ctypes.c_double),
+        ("hbm_total_bytes", ctypes.c_double),
+        ("hbm_bw_util", ctypes.c_double),
+    ]
+
+
+def build_native(force: bool = False) -> Path:
+    """Build the C++ core with cmake+ninja if the shared library is missing."""
+    if _LIB_PATH.exists() and not force:
+        return _LIB_PATH
+    subprocess.run(
+        ["cmake", "-S", str(_REPO_ROOT / "cpp"), "-B", str(_BUILD_DIR),
+         "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", str(_BUILD_DIR)], check=True, capture_output=True
+    )
+    return _LIB_PATH
+
+
+_lib: ctypes.CDLL | None = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(build_native()))
+        lib.tpu_exporter_create.restype = ctypes.c_void_p
+        lib.tpu_exporter_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+        ]
+        lib.tpu_exporter_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpu_exporter_push_samples.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(_CChipSample), ctypes.c_int32,
+        ]
+        lib.tpu_exporter_set_attribution.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.tpu_exporter_clear_attribution.argtypes = [ctypes.c_void_p]
+        lib.tpu_exporter_replace_attribution.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int32,
+        ]
+        lib.tpu_exporter_render.restype = ctypes.c_int64
+        lib.tpu_exporter_render.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.tpu_exporter_port.restype = ctypes.c_int32
+        lib.tpu_exporter_port.argtypes = [ctypes.c_void_p]
+        lib.tpu_exporter_request_count.restype = ctypes.c_uint64
+        lib.tpu_exporter_request_count.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class NativeExporter:
+    """RAII wrapper over the C ABI.
+
+    ``port=0`` binds an ephemeral port (tests), ``port=-1`` disables HTTP
+    (render-only).  ``staleness_ms`` controls when /metrics flips
+    ``tpu_metrics_exporter_up`` to 0 and withholds chip gauges.
+    """
+
+    def __init__(
+        self,
+        node_name: str,
+        listen_addr: str = "0.0.0.0",
+        port: int = 9400,
+        staleness_ms: int = 10_000,
+    ):
+        self._lib = _load()
+        self._handle = self._lib.tpu_exporter_create(
+            node_name.encode(), listen_addr.encode(), port, staleness_ms
+        )
+        if not self._handle:
+            raise OSError(f"native exporter failed to bind {listen_addr}:{port}")
+
+    def push(self, chips: list[ChipSample]) -> None:
+        arr = (_CChipSample * len(chips))(
+            *[
+                _CChipSample(
+                    c.accel_index,
+                    c.tensorcore_util,
+                    c.duty_cycle,
+                    c.hbm_usage_bytes,
+                    c.hbm_total_bytes,
+                    c.hbm_bw_util,
+                )
+                for c in chips
+            ]
+        )
+        self._lib.tpu_exporter_push_samples(self._handle, arr, len(chips))
+
+    def set_attribution(self, mapping: dict[int, tuple[str, str]]) -> None:
+        """Atomically replace the chip→(namespace, pod) attribution table; a
+        concurrent scrape sees the old or new mapping, never a partial one."""
+        n = len(mapping)
+        indices = (ctypes.c_int32 * n)(*mapping.keys())
+        namespaces = (ctypes.c_char_p * n)(*[ns.encode() for ns, _ in mapping.values()])
+        pods = (ctypes.c_char_p * n)(*[pod.encode() for _, pod in mapping.values()])
+        self._lib.tpu_exporter_replace_attribution(
+            self._handle, indices, namespaces, pods, n
+        )
+
+    def render(self) -> str:
+        size = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.tpu_exporter_render(self._handle, buf, size)
+            if n >= 0:
+                return buf.raw[:n].decode()
+            size = -n
+
+    @property
+    def port(self) -> int:
+        return self._lib.tpu_exporter_port(self._handle)
+
+    @property
+    def request_count(self) -> int:
+        return self._lib.tpu_exporter_request_count(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tpu_exporter_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,106 @@
+"""Chip→pod attribution via the kubelet PodResources API.
+
+dcgm-exporter attributes GPUs to pods by mounting the kubelet pod-resources
+socket and setting DCGM_EXPORTER_KUBERNETES=true (dcgm-exporter.yaml:33-34,
+50-52,57-59); the device-id join key is chosen by ``--kubernetes-gpu-id-type
+device-name`` (dcgm-exporter.yaml:37).  The TPU analog queries the same API —
+``v1.PodResourcesLister/List`` on ``/var/lib/kubelet/pod-resources/kubelet.sock``
+— for allocations of the extended resource ``google.com/tpu``, and joins on the
+chip index parsed from the device id (SURVEY.md §7 hard-part (a)).
+
+Wire schema consumed (unknown fields skipped — see utils/protowire):
+
+    ListPodResourcesResponse { repeated PodResources pod_resources = 1; }
+    PodResources  { string name = 1; string namespace = 2;
+                    repeated ContainerResources containers = 3; }
+    ContainerResources { string name = 1; repeated ContainerDevices devices = 2; }
+    ContainerDevices   { string resource_name = 1; repeated string device_ids = 2; }
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Protocol
+
+from k8s_gpu_hpa_tpu.utils import protowire
+
+TPU_RESOURCE = "google.com/tpu"
+DEFAULT_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+
+_TRAILING_INT = re.compile(r"(\d+)\s*$")
+
+
+def parse_device_index(device_id: str) -> int | None:
+    """Map a device-plugin device id to a chip index.
+
+    GKE's TPU device plugin advertises integer-indexed devices; ids appear as
+    plain integers or with a device-path prefix (``"3"``, ``"accel3"``,
+    ``"/dev/accel3"``).  The trailing integer is the chip index — the analog of
+    dcgm-exporter's device-name id type (dcgm-exporter.yaml:37).
+    """
+    m = _TRAILING_INT.search(device_id)
+    return int(m.group(1)) if m else None
+
+
+def parse_list_response(
+    data: bytes, resource_name: str = TPU_RESOURCE
+) -> dict[int, tuple[str, str]]:
+    """Decode a ListPodResourcesResponse into {chip_index: (namespace, pod)}."""
+    mapping: dict[int, tuple[str, str]] = {}
+    for pod_blob in protowire.fields_by_number(data).get(1, []):
+        pod_fields = protowire.fields_by_number(pod_blob)
+        name = (pod_fields.get(1, [b""])[0]).decode()
+        namespace = (pod_fields.get(2, [b""])[0]).decode()
+        for container_blob in pod_fields.get(3, []):
+            container_fields = protowire.fields_by_number(container_blob)
+            for device_blob in container_fields.get(2, []):
+                device_fields = protowire.fields_by_number(device_blob)
+                res = (device_fields.get(1, [b""])[0]).decode()
+                if res != resource_name:
+                    continue
+                for device_id in device_fields.get(2, []):
+                    idx = parse_device_index(device_id.decode())
+                    if idx is not None:
+                        mapping[idx] = (namespace, name)
+    return mapping
+
+
+@dataclass
+class PodResourcesClient:
+    """gRPC client for the kubelet socket; raw-bytes marshalling so no
+    generated stubs are needed (request message is empty)."""
+
+    socket_path: str = DEFAULT_SOCKET
+    resource_name: str = TPU_RESOURCE
+
+    def list_allocations(self) -> dict[int, tuple[str, str]]:
+        import grpc  # deferred: only the on-node daemon needs it
+
+        channel = grpc.insecure_channel(f"unix://{self.socket_path}")
+        try:
+            call = channel.unary_unary(
+                "/v1.PodResourcesLister/List",
+                request_serializer=lambda _: b"",
+                response_deserializer=lambda raw: raw,
+            )
+            raw = call(None, timeout=5.0)
+            return parse_list_response(raw, self.resource_name)
+        finally:
+            channel.close()
+
+
+class StaticAttributor:
+    """Hardware-free attributor for tests and the simulation harness."""
+
+    def __init__(self, mapping: dict[int, tuple[str, str]] | None = None):
+        self.mapping = dict(mapping or {})
+
+    def list_allocations(self) -> dict[int, tuple[str, str]]:
+        return dict(self.mapping)
+
+
+class Attributor(Protocol):
+    """Anything that can report {chip_index: (namespace, pod)} allocations."""
+
+    def list_allocations(self) -> dict[int, tuple[str, str]]: ...

@@ -1,0 +1,205 @@
+"""Chip-metric sources for the exporter daemon.
+
+The acquisition side of the exporter (the part DCGM does in C for GPUs,
+SURVEY.md §2b).  Three implementations of one protocol — ``sample() ->
+list[ChipSample]``:
+
+- ``StubSource``     — scripted utilization curves; powers the hardware-free
+                       integration tests (the stub-metrics-server story
+                       SURVEY.md §4 calls for).
+- ``JaxDeviceSource``— real local readings without the libtpu sidecar: HBM
+                       usage from ``device.memory_stats()`` (ground truth), and
+                       tensorcore utilization self-reported by the in-process
+                       load generator (achieved/peak FLOPs) — used by bench on
+                       the single real chip.
+- ``LibtpuSource``   — the production GKE path: gRPC to the libtpu
+                       runtime-metrics service on localhost:8431 (the same
+                       source ``tpu-info`` reads), decoded at the wire level.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+from k8s_gpu_hpa_tpu.metrics.schema import ChipSample
+from k8s_gpu_hpa_tpu.utils import protowire
+from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
+
+
+class MetricsSource(Protocol):
+    def sample(self) -> list[ChipSample]: ...
+
+
+@dataclass
+class StubSource:
+    """Synthetic chips driven by a utilization function of time.
+
+    ``util_fn(t, chip_index) -> percent``; HBM and bandwidth derive from
+    utilization the same way the sim cluster's fake exporter does, so stub and
+    sim agree on the schema.
+    """
+
+    num_chips: int = 4
+    util_fn: Callable[[float, int], float] = lambda t, i: 50.0
+    hbm_total: float = 16e9
+    clock: Clock = field(default_factory=SystemClock)
+
+    def __post_init__(self):
+        self._t0 = self.clock.now()
+
+    def sample(self) -> list[ChipSample]:
+        t = self.clock.now() - self._t0
+        chips = []
+        for i in range(self.num_chips):
+            util = max(0.0, min(100.0, self.util_fn(t, i)))
+            chips.append(
+                ChipSample(
+                    accel_index=i,
+                    tensorcore_util=util,
+                    duty_cycle=min(100.0, util * 1.1),
+                    hbm_usage_bytes=0.5e9 + (self.hbm_total - 0.5e9) * util / 100.0,
+                    hbm_total_bytes=self.hbm_total,
+                    hbm_bw_util=util * 0.6,
+                )
+            )
+        return chips
+
+
+class JaxDeviceSource:
+    """Samples the local JAX devices directly.
+
+    HBM numbers come from ``device.memory_stats()`` (``bytes_in_use`` /
+    ``bytes_limit``), which XLA reports for real TPU chips.  TensorCore
+    utilization has no portable in-process probe, so it is supplied by
+    ``util_fn`` — the load generator self-reports achieved/peak FLOPs
+    (loadgen/matmul.py), which on one chip is the honest measure.
+    """
+
+    def __init__(self, util_fn: Callable[[int], float] | None = None):
+        import jax
+
+        self._devices = jax.local_devices()
+        self._util_fn = util_fn or (lambda i: 0.0)
+
+    def sample(self) -> list[ChipSample]:
+        chips = []
+        for i, dev in enumerate(self._devices):
+            stats = {}
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                pass  # some backends (cpu) expose no stats; report zeros
+            used = float(stats.get("bytes_in_use", 0))
+            total = float(stats.get("bytes_limit", 0))
+            util = max(0.0, min(100.0, self._util_fn(i)))
+            chips.append(
+                ChipSample(
+                    accel_index=i,
+                    tensorcore_util=util,
+                    duty_cycle=util,
+                    hbm_usage_bytes=used,
+                    hbm_total_bytes=total,
+                    hbm_bw_util=0.0,  # needs the libtpu counter; 0 when absent
+                )
+            )
+        return chips
+
+
+# libtpu runtime-metrics metric names (as surfaced by tpu-info / GKE docs).
+LIBTPU_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+LIBTPU_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
+LIBTPU_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+
+
+def parse_metric_response(data: bytes) -> dict[int, float]:
+    """Extract {device_id: value} pairs from a libtpu MetricResponse.
+
+    Wire shape (decoded generically; unknown fields skipped):
+
+        MetricResponse { TPUMetric metric = 1; }
+        TPUMetric { string name = 1; repeated Metric metrics = 2; }
+        Metric { Attribute attribute = 1; Gauge gauge = 2; }
+        Attribute { string key = 1; AttrValue value = 2; }   # device-id holder
+        AttrValue { int64 int_attr = 2; }
+        Gauge { double as_double = 1; int64 as_int = 2; }
+
+    Structured this way so it is unit-testable from synthetic bytes; the
+    on-hardware shape is validated against a live libtpu on a GKE node.
+    """
+    out: dict[int, float] = {}
+    top = protowire.fields_by_number(data)
+    for tpu_metric in top.get(1, []):
+        for metric_blob in protowire.fields_by_number(tpu_metric).get(2, []):
+            fields = protowire.fields_by_number(metric_blob)
+            device_id = 0
+            for attr in fields.get(1, []):
+                attr_fields = protowire.fields_by_number(attr)
+                for value_blob in attr_fields.get(2, []):
+                    value_fields = protowire.fields_by_number(value_blob)
+                    if 2 in value_fields:
+                        device_id = int(value_fields[2][0])
+            value = 0.0
+            for gauge in fields.get(2, []):
+                gauge_fields = protowire.fields_by_number(gauge)
+                if 1 in gauge_fields:  # fixed64 double
+                    value = protowire.as_double(int(gauge_fields[1][0]))
+                elif 2 in gauge_fields:  # int64 varint
+                    value = float(int(gauge_fields[2][0]))
+            out[device_id] = value
+    return out
+
+
+@dataclass
+class LibtpuSource:
+    """gRPC client of the libtpu runtime-metrics service (production path).
+
+    The channel is created lazily and kept for the daemon's lifetime —
+    ``sample()`` runs every collect interval (1 s), so per-sweep channel
+    setup/teardown would add avoidable latency and connection churn.
+    """
+
+    address: str = "localhost:8431"
+    timeout: float = 3.0
+    _channel: object = field(default=None, repr=False)
+
+    def _get_metric(self, name: str) -> dict[int, float]:
+        call = self._channel.unary_unary(
+            "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric",
+            request_serializer=lambda req: req,  # pre-encoded bytes
+            response_deserializer=lambda raw: raw,
+        )
+        request = protowire.encode_string(1, name)  # MetricRequest.metric_name
+        return parse_metric_response(call(request, timeout=self.timeout))
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    def sample(self) -> list[ChipSample]:
+        import grpc  # deferred: only the on-node daemon needs it
+
+        if self._channel is None:
+            self._channel = grpc.insecure_channel(self.address)
+        try:
+            duty = self._get_metric(LIBTPU_DUTY_CYCLE)
+            usage = self._get_metric(LIBTPU_HBM_USAGE)
+            total = self._get_metric(LIBTPU_HBM_TOTAL)
+        except Exception:
+            self.close()  # drop a possibly-wedged channel; reconnect next sweep
+            raise
+        chips = []
+        for device_id in sorted(set(duty) | set(usage) | set(total)):
+            d = duty.get(device_id, 0.0)
+            chips.append(
+                ChipSample(
+                    accel_index=device_id,
+                    tensorcore_util=d,  # duty cycle is the utilization proxy
+                    duty_cycle=d,
+                    hbm_usage_bytes=usage.get(device_id, 0.0),
+                    hbm_total_bytes=total.get(device_id, 0.0),
+                    hbm_bw_util=0.0,  # not exposed by all libtpu versions
+                )
+            )
+        return chips

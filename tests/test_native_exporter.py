@@ -1,0 +1,251 @@
+"""Native (C++) exporter tests: the L2 component, hardware-free.
+
+These are the automated version of the reference's exporter smoke probe
+(``curl localhost:9400/metrics | grep dcgm_gpu_temp``, README.md:42-47), plus
+contract tests the reference never had: the C++ text renderer must agree with
+the Python reference encoder sample-for-sample, and the freshness watchdog must
+withhold stale readings instead of serving them silently."""
+
+import threading
+import urllib.request
+
+import pytest
+
+from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+from k8s_gpu_hpa_tpu.exporter.native import NativeExporter, build_native
+from k8s_gpu_hpa_tpu.exporter.podresources import StaticAttributor
+from k8s_gpu_hpa_tpu.exporter.sources import StubSource
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text, parse_text
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    CHIP_METRICS,
+    ChipSample,
+    TPU_TENSORCORE_UTIL,
+    families_from_chips,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    build_native()
+
+
+def chips_fixture():
+    return [
+        ChipSample(0, 42.5, 46.75, 7.09e9, 16e9, 25.5),
+        ChipSample(1, 99.0, 100.0, 15.845e9, 16e9, 59.4),
+    ]
+
+
+def http_get(port, path="/metrics"):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_render_only_mode_no_http():
+    with NativeExporter("n0", port=-1) as ex:
+        assert ex.port == -1
+        ex.push(chips_fixture())
+        text = ex.render()
+        assert TPU_TENSORCORE_UTIL in text
+
+
+def test_cpp_renderer_agrees_with_python_encoder():
+    """Same inputs through the C++ renderer and the Python encoder must parse
+    to the identical sample set (name, labels, value)."""
+    attribution = {0: ("default", "tpu-test-abc")}
+    with NativeExporter("node-x", port=-1) as ex:
+        ex.push(chips_fixture())
+        ex.set_attribution(attribution)
+        cpp_parsed = parse_text(ex.render())
+    py_parsed = parse_text(
+        encode_text(families_from_chips(chips_fixture(), "node-x", attribution))
+    )
+
+    def sample_set(fams):
+        return {
+            (f.name, s.labels, s.value)
+            for f in fams
+            for s in f.samples
+            if f.name in CHIP_METRICS
+        }
+
+    assert sample_set(cpp_parsed) == sample_set(py_parsed)
+
+
+def test_http_metrics_endpoint():
+    with NativeExporter("n0", listen_addr="127.0.0.1", port=0) as ex:
+        ex.push(chips_fixture())
+        status, body = http_get(ex.port)
+        assert status == 200
+        assert "tpu_metrics_exporter_up" in body
+        fams = {f.name: f for f in parse_text(body)}
+        assert fams[TPU_TENSORCORE_UTIL].samples[0].label("node") == "n0"
+        assert ex.request_count == 1
+
+
+def test_http_healthz_and_404():
+    with NativeExporter("n0", listen_addr="127.0.0.1", port=0) as ex:
+        status, body = http_get(ex.port, "/healthz")
+        assert (status, body) == (200, "ok\n")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            http_get(ex.port, "/nonexistent")
+        assert exc_info.value.code == 404
+
+
+def test_staleness_watchdog_withholds_chip_gauges():
+    with NativeExporter("n0", port=-1, staleness_ms=50) as ex:
+        ex.push(chips_fixture())
+        assert TPU_TENSORCORE_UTIL in ex.render()
+        import time
+
+        time.sleep(0.15)
+        text = ex.render()
+        assert TPU_TENSORCORE_UTIL not in text  # withheld, not frozen
+        assert 'tpu_metrics_exporter_up{node="n0"} 0' in text
+
+
+def test_no_push_ever_reports_down():
+    with NativeExporter("n0", port=-1) as ex:
+        text = ex.render()
+        assert 'tpu_metrics_exporter_up{node="n0"} 0' in text
+        assert "sample_age" not in text
+
+
+def test_unallocated_chips_export_empty_pod():
+    with NativeExporter("n0", port=-1) as ex:
+        ex.push(chips_fixture())
+        ex.set_attribution({0: ("default", "p0")})
+        fams = {f.name: f for f in parse_text(ex.render())}
+        by_chip = {s.label("chip"): s for s in fams[TPU_TENSORCORE_UTIL].samples}
+        assert by_chip["0"].label("pod") == "p0"
+        assert by_chip["1"].label("pod") == ""
+
+
+def test_attribution_replacement_clears_old_entries():
+    with NativeExporter("n0", port=-1) as ex:
+        ex.push(chips_fixture())
+        ex.set_attribution({0: ("default", "old-pod"), 1: ("default", "b")})
+        ex.set_attribution({1: ("default", "new-pod")})
+        fams = {f.name: f for f in parse_text(ex.render())}
+        by_chip = {s.label("chip"): s for s in fams[TPU_TENSORCORE_UTIL].samples}
+        assert by_chip["0"].label("pod") == ""
+        assert by_chip["1"].label("pod") == "new-pod"
+
+
+def test_concurrent_scrapes():
+    """Prometheus scrapes serially but multiple Prometheis (or a human curl
+    during a scrape) may overlap; the server must not corrupt responses."""
+    with NativeExporter("n0", listen_addr="127.0.0.1", port=0) as ex:
+        ex.push(chips_fixture())
+        errors = []
+
+        def scrape():
+            try:
+                status, body = http_get(ex.port)
+                assert status == 200
+                assert body.endswith("\n")
+                parse_text(body)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=scrape) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert ex.request_count == 16
+
+
+def test_daemon_sweep_and_attribution():
+    source = StubSource(num_chips=2, util_fn=lambda t, i: 70.0)
+    attributor = StaticAttributor({0: ("default", "tpu-test-0")})
+    with ExporterDaemon(
+        source, attributor, node_name="n0", listen_addr="127.0.0.1", port=0
+    ) as daemon:
+        daemon.step()
+        status, body = http_get(daemon.port)
+        fams = {f.name: f for f in parse_text(body)}
+        by_chip = {s.label("chip"): s for s in fams[TPU_TENSORCORE_UTIL].samples}
+        assert by_chip["0"].value == 70.0
+        assert by_chip["0"].label("pod") == "tpu-test-0"
+        assert by_chip["1"].label("pod") == ""
+
+
+def test_daemon_survives_failing_source():
+    class ExplodingSource:
+        def sample(self):
+            raise RuntimeError("libtpu away")
+
+    with ExporterDaemon(
+        ExplodingSource(), node_name="n0", listen_addr="127.0.0.1", port=0
+    ) as daemon:
+        daemon.step()  # must not raise
+        status, body = http_get(daemon.port)
+        assert 'tpu_metrics_exporter_up{node="n0"} 0' in body
+
+
+def test_real_exporter_feeds_sim_pipeline_over_http():
+    """End-to-end L2→L3→L4→L5 with the real C++ exporter as the scrape target:
+    the closed-loop harness from test_closed_loop, but the utilization readings
+    travel through the actual native /metrics endpoint over TCP."""
+    from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+    from k8s_gpu_hpa_tpu.control.hpa import HPAController, ObjectMetricSpec
+    from k8s_gpu_hpa_tpu.metrics.rules import RuleEvaluator, tpu_test_avg_rule
+    from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    util = {"value": 20.0}
+    source = StubSource(num_chips=1, util_fn=lambda t, i: util["value"])
+    attributor = StaticAttributor({0: ("default", "tpu-test-0000")})
+
+    class FakeTarget:
+        replicas = 1
+
+        def scale_to(self, n):
+            self.replicas = n
+
+    with ExporterDaemon(
+        source, attributor, node_name="n0", listen_addr="127.0.0.1", port=0
+    ) as daemon:
+        clock = VirtualClock()
+        db = TimeSeriesDB(clock)
+        scraper = Scraper(db)
+        scraper.add_target(
+            lambda: http_get(daemon.port)[1], name="exporter/n0", node="n0"
+        )
+        scraper.add_target(
+            lambda: (
+                "# TYPE kube_pod_labels gauge\n"
+                'kube_pod_labels{namespace="default",pod="tpu-test-0000",label_app="tpu-test"} 1\n'
+            ),
+            name="ksm",
+        )
+        evaluator = RuleEvaluator(db, [tpu_test_avg_rule()])
+        adapter = CustomMetricsAdapter(db, [AdapterRule(series="tpu_test_tensorcore_avg")])
+        target = FakeTarget()
+        hpa = HPAController(
+            target=target,
+            metrics=[
+                ObjectMetricSpec(
+                    "tpu_test_tensorcore_avg",
+                    40.0,
+                    ObjectReference("Deployment", "tpu-test", "default"),
+                )
+            ],
+            adapter=adapter,
+            clock=clock,
+        )
+
+        def tick():
+            daemon.step()
+            scraper.scrape_once()
+            evaluator.evaluate_once()
+            clock.advance(15.0)
+            return hpa.sync_once()
+
+        tick()
+        assert target.replicas == 1
+        util["value"] = 95.0  # the kubectl-exec load doubling (README.md:113-116)
+        tick()
+        assert target.replicas == 3  # ceil(1 * 95/40)

@@ -1,0 +1,136 @@
+"""Protobuf wire codec + PodResources/libtpu response parsing.
+
+The chip→pod attribution joint is SURVEY.md §7's hard-part (a); these tests
+build kubelet ListPodResourcesResponse messages byte-by-byte (plus unknown
+fields, as a newer kubelet would send) and check the mapping that falls out."""
+
+import struct
+
+import pytest
+
+from k8s_gpu_hpa_tpu.exporter.podresources import (
+    parse_device_index,
+    parse_list_response,
+)
+from k8s_gpu_hpa_tpu.exporter.sources import parse_metric_response
+from k8s_gpu_hpa_tpu.utils import protowire
+from k8s_gpu_hpa_tpu.utils.protowire import (
+    encode_string,
+    encode_tag,
+    encode_varint,
+)
+
+
+def encode_message(field: int, payload: bytes) -> bytes:
+    return encode_tag(field, protowire.BYTES) + encode_varint(len(payload)) + payload
+
+
+def encode_varint_field(field: int, value: int) -> bytes:
+    return encode_tag(field, protowire.VARINT) + encode_varint(value)
+
+
+def encode_double_field(field: int, value: float) -> bytes:
+    return encode_tag(field, protowire.FIXED64) + struct.pack("<d", value)
+
+
+# ---- wire codec ------------------------------------------------------------
+
+
+def test_varint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**32, 2**60]:
+        fields = protowire.decode_fields(encode_varint_field(3, v))
+        assert fields == [(3, protowire.VARINT, v)]
+
+
+def test_string_roundtrip():
+    data = encode_string(2, "kube-system")
+    assert protowire.fields_by_number(data)[2] == [b"kube-system"]
+
+
+def test_truncated_message_raises():
+    data = encode_string(1, "hello")[:-2]
+    with pytest.raises(ValueError):
+        protowire.decode_fields(data)
+
+
+def test_unknown_wire_type_raises():
+    with pytest.raises(ValueError):
+        protowire.decode_fields(bytes([0x0B]))  # field 1, wire type 3 (group)
+
+
+def test_fixed_fields():
+    data = encode_double_field(5, 42.5) + encode_tag(6, protowire.FIXED32) + b"\x01\x00\x00\x00"
+    fields = protowire.fields_by_number(data)
+    assert protowire.as_double(int(fields[5][0])) == 42.5
+    assert fields[6] == [1]
+
+
+# ---- PodResources response parsing -----------------------------------------
+
+
+def make_pod(name, namespace, devices, resource="google.com/tpu"):
+    dev_msg = encode_string(1, resource) + b"".join(
+        encode_string(2, d) for d in devices
+    )
+    container = encode_string(1, "main") + encode_message(2, dev_msg)
+    return encode_string(1, name) + encode_string(2, namespace) + encode_message(3, container)
+
+
+def test_parse_device_index_forms():
+    assert parse_device_index("3") == 3
+    assert parse_device_index("accel7") == 7
+    assert parse_device_index("/dev/accel0") == 0
+    assert parse_device_index("tpu-12") == 12
+    assert parse_device_index("no-digits") is None
+
+
+def test_parse_list_response_basic():
+    resp = encode_message(1, make_pod("tpu-test-abc", "default", ["0", "1"]))
+    assert parse_list_response(resp) == {
+        0: ("default", "tpu-test-abc"),
+        1: ("default", "tpu-test-abc"),
+    }
+
+
+def test_parse_list_response_filters_other_resources():
+    resp = encode_message(
+        1, make_pod("gpu-pod", "default", ["0"], resource="nvidia.com/gpu")
+    ) + encode_message(1, make_pod("tpu-pod", "prod", ["/dev/accel2"]))
+    assert parse_list_response(resp) == {2: ("prod", "tpu-pod")}
+
+
+def test_parse_list_response_skips_unknown_fields():
+    """A newer kubelet adds fields (cpu_ids etc.); parser must skip them."""
+    pod = make_pod("p", "default", ["1"])
+    pod += encode_varint_field(9, 12345)  # unknown varint field
+    pod += encode_message(7, b"\x08\x01")  # unknown nested message
+    resp = encode_message(1, pod) + encode_varint_field(15, 7)
+    assert parse_list_response(resp) == {1: ("default", "p")}
+
+
+def test_parse_list_response_empty():
+    assert parse_list_response(b"") == {}
+
+
+# ---- libtpu MetricResponse parsing -----------------------------------------
+
+
+def make_metric(device_id, value, as_int=False):
+    attr_value = encode_varint_field(2, device_id)
+    attribute = encode_string(1, "device-id") + encode_message(2, attr_value)
+    gauge = (
+        encode_varint_field(2, int(value)) if as_int else encode_double_field(1, value)
+    )
+    return encode_message(1, attribute) + encode_message(2, gauge)
+
+
+def test_parse_metric_response_doubles_and_ints():
+    tpu_metric = encode_string(1, "tpu.runtime.tensorcore.dutycycle.percent")
+    tpu_metric += encode_message(2, make_metric(0, 73.5))
+    tpu_metric += encode_message(2, make_metric(1, 16_000_000_000, as_int=True))
+    resp = encode_message(1, tpu_metric)
+    assert parse_metric_response(resp) == {0: 73.5, 1: 16_000_000_000.0}
+
+
+def test_parse_metric_response_empty():
+    assert parse_metric_response(b"") == {}
