@@ -1,0 +1,270 @@
+"""Closed-loop autoscaling bench: HPA scale-up latency with a real chip in the loop.
+
+Measures the north-star metric (BASELINE.md): seconds from the recorded
+utilization series crossing the HPA target (40%) to the deployment reaching 4
+replicas all Running.  The reference publishes no numbers (SURVEY.md §6); the
+budget is 60 s, set by the stack of delays the reference suffers from
+(exporter collect interval + scrape + rule eval + adapter poll + HPA sync +
+pod start latency, README.md:123).
+
+What is real vs simulated:
+
+- REAL: the load generator (bf16 matmul bursts on the local accelerator — the
+  TPU chip when present), its self-reported utilization, the native C++
+  exporter serving /metrics over HTTP, the Prometheus-semantics scrape loop,
+  recording-rule evaluation, the custom-metrics adapter, and the
+  autoscaling/v2 HPA algorithm configured FROM deploy/tpu-test-hpa.yaml.
+- SIMULATED: pod lifecycle.  One chip cannot host four pods, so replicas 2-4
+  are mirror pods that start after a GKE-realistic pod-start latency (12 s)
+  and report the real chip's measured utilization; the real generator's duty
+  cycle is re-commanded to offered/n_running each tick, so the chip actually
+  runs the per-pod load every replica would see (shared-load feedback).
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"} where value is
+the p50 latency over trials and vs_baseline = 60 / value (>1 beats the budget).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+import yaml
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPAController,
+    ObjectMetricSpec,
+    behavior_from_manifest,
+)
+from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+from k8s_gpu_hpa_tpu.exporter.podresources import StaticAttributor
+from k8s_gpu_hpa_tpu.exporter.sources import JaxDeviceSource
+from k8s_gpu_hpa_tpu.loadgen.matmul import MatmulLoadGen
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.metrics.rules import RuleEvaluator, tpu_test_avg_rule
+from k8s_gpu_hpa_tpu.metrics.schema import ChipSample, MetricFamily, families_from_chips
+from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import SystemClock
+
+TARGET = 40.0
+MAX_REPLICAS = 4
+POD_START_LATENCY = 12.0
+HPA_SYNC = 15.0
+BUDGET_S = 60.0
+
+
+class MirrorDeployment:
+    """Scalable target whose pods mirror the real chip's utilization."""
+
+    def __init__(self, clock: SystemClock):
+        self.clock = clock
+        self.replicas = 1
+        #: pod name -> ready_at timestamp (real pod is always ready)
+        self.pods: dict[str, float] = {"tpu-test-real": -1.0}
+        self._counter = 0
+
+    def scale_to(self, n: int) -> None:
+        while len(self.pods) < n:
+            self._counter += 1
+            self.pods[f"tpu-test-sim{self._counter}"] = (
+                self.clock.now() + POD_START_LATENCY
+            )
+        while len(self.pods) > n:
+            self.pods.pop(next(reversed(self.pods)))
+        self.replicas = n
+
+    def running(self) -> list[str]:
+        now = self.clock.now()
+        return [p for p, ready in self.pods.items() if ready <= now]
+
+
+def http_fetch(port: int) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def run_trial(gen: MatmulLoadGen, daemon: ExporterDaemon, log) -> float:
+    clock = SystemClock()
+    # settle: drop to the pre-spike duty cycle and wait until the measured
+    # utilization window has flushed the previous trial's load, so the
+    # crossing detection starts from a true below-target baseline
+    gen.set_intensity(0.2)
+    settle_deadline = clock.now() + 30.0
+    while gen.utilization() > 30.0 and clock.now() < settle_deadline:
+        time.sleep(0.25)
+    deployment = MirrorDeployment(clock)
+    db = TimeSeriesDB(clock)
+    scraper = Scraper(db)
+
+    # Real exporter over HTTP: the real chip is pod tpu-test-real on node
+    # real-0 (attribution set via the daemon's attributor at construction).
+    scraper.add_target(lambda: http_fetch(daemon.port), name="exporter/real", node="real-0")
+
+    # Mirror pods: one synthetic node whose chips mirror the real chip's
+    # current measured utilization (only for pods that have started).
+    def sim_exporter() -> str:
+        util = gen.utilization()
+        chips, attribution = [], {}
+        for i, pod in enumerate(p for p in deployment.running() if p != "tpu-test-real"):
+            chips.append(ChipSample(i, util, util, 8e9, 16e9, util * 0.6))
+            attribution[i] = ("default", pod)
+        return encode_text(families_from_chips(chips, "sim-0", attribution))
+
+    scraper.add_target(sim_exporter, name="exporter/sim", node="sim-0")
+
+    def ksm() -> str:
+        fam = MetricFamily("kube_pod_labels", "gauge")
+        for pod in deployment.pods:
+            fam.add(1.0, namespace="default", pod=pod, label_app="tpu-test")
+        return encode_text([fam])
+
+    scraper.add_target(ksm, name="ksm")
+
+    evaluator = RuleEvaluator(db, [tpu_test_avg_rule()])
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series="tpu_test_tensorcore_avg")])
+    hpa_doc = yaml.safe_load((Path(__file__).parent / "deploy/tpu-test-hpa.yaml").read_text())
+    hpa = HPAController(
+        target=deployment,
+        metrics=[
+            ObjectMetricSpec(
+                "tpu_test_tensorcore_avg", TARGET,
+                ObjectReference("Deployment", "tpu-test", "default"),
+            )
+        ],
+        adapter=adapter,
+        clock=clock,
+        min_replicas=1,
+        max_replicas=MAX_REPLICAS,
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+
+    offered = 0.2  # fraction-of-one-chip units; <40% utilization
+    spike_at = clock.now() + 6.0
+    t_cross = None
+    t_done = None
+    next_scrape = clock.now()
+    next_sync = clock.now() + HPA_SYNC
+    deadline = clock.now() + 240.0
+
+    while clock.now() < deadline:
+        now = clock.now()
+        if now >= spike_at:
+            offered = 8.0  # 8x one chip: drives per-pod util to 100 until 4 pods
+        # command the generator (running in its own thread, like a real pod's
+        # process) to the per-pod share of the offered load
+        gen.set_intensity(min(1.0, offered / max(1, len(deployment.running()))))
+        if now >= next_scrape:
+            scraper.scrape_once()
+            evaluator.evaluate_once()
+            next_scrape = now + 1.0
+            value = db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"})
+            if t_cross is None and value is not None and value > TARGET:
+                t_cross = clock.now()
+                log(f"  crossed {TARGET}% at t={t_cross - spike_at:+.1f}s after spike")
+        if now >= next_sync:
+            status = hpa.sync_once()
+            next_sync = now + HPA_SYNC
+            log(
+                f"  hpa sync: value={status.last_metric_values.get('tpu_test_tensorcore_avg', float('nan')):.1f}"
+                f" replicas={deployment.replicas} running={len(deployment.running())}"
+            )
+        if (
+            t_cross is not None
+            and t_done is None
+            and deployment.replicas == MAX_REPLICAS
+            and len(deployment.running()) == MAX_REPLICAS
+        ):
+            t_done = clock.now()
+            break
+        time.sleep(0.05)
+
+    if t_cross is None or t_done is None:
+        raise RuntimeError("trial did not complete: no crossing or no scale-up")
+    return t_done - t_cross
+
+
+def main() -> None:
+    log = lambda msg: print(msg, file=sys.stderr, flush=True)
+    import jax
+
+    backend = jax.default_backend()
+    size = 4096 if backend == "tpu" else 512
+    log(f"bench: backend={backend}, matmul size={size}")
+    gen = MatmulLoadGen(size=size, intensity=0.2, window=3.0)
+    # don't let a stray intensity file override the commanded duty cycle
+    gen.intensity_file = f"/tmp/bench-intensity-{id(gen)}"
+    gen.warmup()
+    source = JaxDeviceSource(util_fn=lambda i: gen.utilization())
+    daemon = ExporterDaemon(
+        source,
+        StaticAttributor({0: ("default", "tpu-test-real")}),
+        node_name="real-0",
+        listen_addr="127.0.0.1",
+        port=0,
+    )
+
+    # background threads: the load generator runs continuously (as it would in
+    # its own pod), and a feeder keeps the exporter fed with fresh sweeps
+    import threading
+
+    stop = threading.Event()
+
+    def generate():
+        while not stop.is_set():
+            gen.step()
+
+    def feed():
+        while not stop.is_set():
+            daemon.step()
+            time.sleep(0.5)
+
+    threads = [
+        threading.Thread(target=generate, daemon=True),
+        threading.Thread(target=feed, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+
+    try:
+        latencies = []
+        for trial in range(3):
+            log(f"trial {trial + 1}:")
+            latency = run_trial(gen, daemon, log)
+            log(f"  scale-up latency: {latency:.1f}s")
+            latencies.append(latency)
+        p50 = statistics.median(latencies)
+        stats = gen.stats()
+        log(
+            f"loadgen: achieved {stats.achieved_tflops:.1f} TFLOP/s busy-time "
+            f"({backend}, {size}x{size} bf16)"
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "hpa_scale_up_p50_latency",
+                    "value": round(p50, 2),
+                    "unit": "s",
+                    "vs_baseline": round(BUDGET_S / p50, 3),
+                }
+            )
+        )
+    finally:
+        # join the worker threads BEFORE tearing down the native exporter:
+        # a feed() mid-push on a destroyed handle aborts the process
+        stop.set()
+        gen.set_intensity(0.0)
+        for t in threads:
+            t.join(timeout=10.0)
+        daemon.close()
+
+
+if __name__ == "__main__":
+    main()

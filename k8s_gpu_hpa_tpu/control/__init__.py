@@ -1,5 +1,6 @@
 from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
 from k8s_gpu_hpa_tpu.control.hpa import (
+    behavior_from_manifest,
     HPABehavior,
     HPAController,
     HPAStatus,
@@ -14,6 +15,7 @@ __all__ = [
     "CustomMetricsAdapter",
     "ObjectReference",
     "HPABehavior",
+    "behavior_from_manifest",
     "HPAController",
     "HPAStatus",
     "ObjectMetricSpec",

@@ -98,6 +98,30 @@ class HPAStatus:
     last_reason: str = ""
 
 
+def behavior_from_manifest(hpa_doc: dict) -> HPABehavior:
+    """Parse the ``behavior:`` stanza of an autoscaling/v2 HPA manifest (as a
+    loaded YAML dict) into the controller's config — so the shipped manifest
+    (deploy/tpu-test-hpa.yaml) can drive the simulator and bench directly."""
+
+    def parse_rules(d: dict) -> ScalingRules:
+        return ScalingRules(
+            stabilization_window_seconds=float(d.get("stabilizationWindowSeconds", 0)),
+            select_policy=d.get("selectPolicy", "Max"),
+            policies=[
+                ScalingPolicy(p["type"], p["value"], float(p["periodSeconds"]))
+                for p in d.get("policies", [])
+            ],
+        )
+
+    b = hpa_doc["spec"].get("behavior", {})
+    behavior = HPABehavior()
+    if "scaleUp" in b:
+        behavior.scale_up = parse_rules(b["scaleUp"])
+    if "scaleDown" in b:
+        behavior.scale_down = parse_rules(b["scaleDown"])
+    return behavior
+
+
 class HPAController:
     """One HPA object + its sync loop (kube-controller-manager syncs every 15 s
     by default; SURVEY.md §3.3)."""

@@ -1,1 +1,12 @@
-"""Placeholder: populated by the loadgen milestone (see package docstring)."""
+from k8s_gpu_hpa_tpu.loadgen.allreduce import AllReduceLoadGen, CollectiveStats
+from k8s_gpu_hpa_tpu.loadgen.matmul import LoadGenStats, MatmulLoadGen
+from k8s_gpu_hpa_tpu.loadgen.train import TrainLoadGen, TrainStats
+
+__all__ = [
+    "AllReduceLoadGen",
+    "CollectiveStats",
+    "LoadGenStats",
+    "MatmulLoadGen",
+    "TrainLoadGen",
+    "TrainStats",
+]

@@ -1,0 +1,5 @@
+"""Entrypoint: ``python -m k8s_gpu_hpa_tpu.loadgen`` (tpu-test container cmd)."""
+
+from k8s_gpu_hpa_tpu.loadgen.matmul import main
+
+main()

@@ -1,0 +1,255 @@
+"""Single-chip TPU load generator: a ``jax.jit`` matmul busy-loop with a duty
+-cycle intensity knob.
+
+TPU analog of the reference workload — a CUDA vectorAdd busy-loop whose only
+"knob" is running more loop iterations via ``kubectl exec``
+(cuda-test-deployment.yaml:19, README.md:113-116).  This generator improves on
+that: intensity is a duty cycle in [0,1] settable at runtime three ways (API,
+env var at start, or a watched file — the ``kubectl exec`` equivalent is
+``echo 0.9 > /tmp/tpu-test-intensity``), and the generator *self-reports* its
+achieved utilization and TFLOP/s, which is what feeds JaxDeviceSource for
+single-chip benches.
+
+TPU-first details: bf16 operands (MXU-native), f32 accumulation, a
+``lax.fori_loop`` chaining matmuls on-device per burst (one dispatch, no host
+round-trip per iteration), static shapes, optional Pallas kernel for the hot op.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from k8s_gpu_hpa_tpu.ops.pallas_matmul import HAVE_PALLAS, matmul_pallas
+
+INTENSITY_ENV = "TPU_TEST_INTENSITY"
+INTENSITY_FILE_ENV = "TPU_TEST_INTENSITY_FILE"
+DEFAULT_INTENSITY_FILE = "/tmp/tpu-test-intensity"
+
+#: bf16 peak TFLOP/s per chip by device kind (public Cloud TPU specs).
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5": 459.0,  # v5p
+    "TPU v6 lite": 918.0,  # v6e / Trillium
+}
+
+
+def peak_tflops_for(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    # longest-prefix match so "TPU v5 lite" wins over "TPU v5"
+    best = None
+    for name, tflops in PEAK_BF16_TFLOPS.items():
+        if kind.startswith(name) and (best is None or len(name) > best[0]):
+            best = (len(name), tflops)
+    return best[1] if best else None
+
+
+@dataclass
+class LoadGenStats:
+    utilization: float  # achieved duty-cycle percent over the last window
+    achieved_tflops: float  # sustained over busy time
+    steps: int
+    busy_seconds: float
+    wall_seconds: float
+
+
+class MatmulLoadGen:
+    """Busy-loop generator.  ``step()`` runs one burst then sleeps to match the
+    target duty cycle; ``stats()`` reports utilization over a sliding window."""
+
+    def __init__(
+        self,
+        size: int = 4096,
+        iters_per_burst: int | None = None,
+        intensity: float | None = None,
+        dtype=jnp.bfloat16,
+        use_pallas: bool = True,
+        device=None,
+        window: float = 10.0,
+    ):
+        self.size = size
+        if iters_per_burst is None:
+            # On real TPUs make bursts long enough to dominate dispatch/tunnel
+            # round-trip overhead; on CPU keep tests fast.
+            iters_per_burst = 256 if jax.default_backend() == "tpu" else 4
+        self.iters_per_burst = iters_per_burst
+        self.device = device or jax.devices()[0]
+        self.window = window
+        self._intensity = (
+            intensity
+            if intensity is not None
+            else float(os.environ.get(INTENSITY_ENV, "1.0"))
+        )
+        self.intensity_file = os.environ.get(
+            INTENSITY_FILE_ENV, DEFAULT_INTENSITY_FILE
+        )
+        self.peak_tflops = peak_tflops_for(self.device)
+        key = jax.random.PRNGKey(0)
+        with jax.default_device(self.device):
+            self._a = jax.random.normal(key, (size, size), dtype=dtype)
+            self._b = jax.random.normal(
+                jax.random.fold_in(key, 1), (size, size), dtype=dtype
+            )
+
+        inner = matmul_pallas if (use_pallas and HAVE_PALLAS) else (
+            lambda a, b: jnp.dot(a, b, preferred_element_type=a.dtype)
+        )
+
+        def burst(a, b):
+            # Chain matmuls so one dispatch keeps the MXU busy for the whole
+            # burst; normalization keeps values from overflowing bf16.  The
+            # return value is a scalar probe: fetching it forces completion
+            # even on backends whose block_until_ready does not actually block
+            # (remote-tunnel platforms), and transfers 4 bytes, not the matrix.
+            def body(_, x):
+                y = inner(x, b)
+                return y * (1.0 / jnp.sqrt(jnp.float32(self.size)).astype(y.dtype))
+
+            out = lax.fori_loop(0, self.iters_per_burst, body, a)
+            return out[0, 0].astype(jnp.float32)
+
+        self._burst = jax.jit(burst)
+        self._tiny = jax.jit(lambda a: (a * 2)[0, 0].astype(jnp.float32))
+        self._rtt = 0.0  # measured dispatch+readback floor, set by warmup()
+        self._history: list[tuple[float, float, float]] = []  # (t, busy, flops)
+        self._steps = 0
+
+    # ---- intensity knob ----------------------------------------------------
+
+    @property
+    def intensity(self) -> float:
+        return self._intensity
+
+    def set_intensity(self, value: float) -> None:
+        self._intensity = max(0.0, min(1.0, value))
+
+    def poll_intensity_file(self) -> None:
+        """The kubectl-exec knob: read a float duty cycle from the watched file
+        (analog of rerunning the vectorAdd loop inside the pod,
+        README.md:113-116)."""
+        try:
+            with open(self.intensity_file) as f:
+                self.set_intensity(float(f.read().strip()))
+        except (OSError, ValueError):
+            pass  # file absent or mid-write: keep current intensity
+
+    # ---- run loop ----------------------------------------------------------
+
+    def warmup(self) -> None:
+        float(self._burst(self._a, self._b))  # compile + first run
+        # calibrate the dispatch/readback floor so achieved-FLOPs numbers can
+        # exclude it (on a remote-tunnel dev setup it is tens of ms; on a real
+        # node it is microseconds)
+        float(self._tiny(self._a))
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(self._tiny(self._a))
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        self._rtt = samples[len(samples) // 2]
+
+    def step(self) -> float:
+        """One burst + duty-cycle sleep; returns busy seconds."""
+        self.poll_intensity_file()
+        intensity = self._intensity  # snapshot: may be set from another thread
+        if intensity <= 0.0:
+            time.sleep(0.05)
+            self._record(0.0, 0.0)
+            return 0.0
+        t0 = time.perf_counter()
+        float(self._burst(self._a, self._b))  # scalar fetch forces completion
+        busy = time.perf_counter() - t0
+        flops = 2.0 * self.size**3 * self.iters_per_burst
+        self._record(busy, flops)
+        self._steps += 1
+        # duty cycle: busy/(busy+idle) = intensity
+        if intensity < 1.0:
+            time.sleep(busy * (1.0 - intensity) / intensity)
+        return busy
+
+    def run_for(self, seconds: float) -> LoadGenStats:
+        end = time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            self.step()
+        return self.stats()
+
+    def _record(self, busy: float, flops: float) -> None:
+        now = time.perf_counter()
+        self._history.append((now, busy, flops))
+        cutoff = now - self.window
+        while self._history and self._history[0][0] < cutoff:
+            self._history.pop(0)
+
+    # ---- self-reporting ----------------------------------------------------
+
+    def stats(self) -> LoadGenStats:
+        if not self._history:
+            return LoadGenStats(0.0, 0.0, self._steps, 0.0, 0.0)
+        busy = sum(b for _, b, _ in self._history)
+        flops = sum(f for _, _, f in self._history)
+        t_first = self._history[0][0]
+        wall = max(time.perf_counter() - t_first, 1e-9)
+        # exclude the calibrated dispatch/readback floor from compute-rate
+        # accounting (it still counts toward duty-cycle utilization, which is
+        # about load patterns, not kernel efficiency)
+        bursts = sum(1 for _, b, _ in self._history if b > 0)
+        compute = max(busy - bursts * self._rtt, 1e-9)
+        return LoadGenStats(
+            utilization=min(100.0, 100.0 * busy / wall),
+            achieved_tflops=(flops / compute / 1e12) if flops > 0 else 0.0,
+            steps=self._steps,
+            busy_seconds=busy,
+            wall_seconds=wall,
+        )
+
+    def utilization(self, _chip_index: int = 0) -> float:
+        """Duty-cycle utilization percent — the ``util_fn`` for JaxDeviceSource."""
+        return self.stats().utilization
+
+    def mxu_utilization(self) -> float | None:
+        """Achieved/peak FLOPs percent, when the chip's peak is known."""
+        if self.peak_tflops is None:
+            return None
+        return min(100.0, 100.0 * self.stats().achieved_tflops / self.peak_tflops)
+
+
+def main() -> None:
+    """``python -m k8s_gpu_hpa_tpu.loadgen`` — the tpu-test container command.
+
+    Env: MATMUL_SIZE, TPU_TEST_INTENSITY (initial duty cycle),
+    TPU_TEST_INTENSITY_FILE (runtime knob), REPORT_S (stats print period).
+    """
+    size = int(os.environ.get("MATMUL_SIZE", "4096"))
+    report_every = float(os.environ.get("REPORT_S", "10"))
+    gen = MatmulLoadGen(size=size)
+    gen.warmup()
+    print(
+        f"tpu-test loadgen: {size}x{size} bf16 matmul bursts on "
+        f"{gen.device.device_kind}, intensity={gen.intensity} "
+        f"(knob: {gen.intensity_file})",
+        flush=True,
+    )
+    last_report = time.perf_counter()
+    while True:
+        gen.step()
+        if time.perf_counter() - last_report >= report_every:
+            s = gen.stats()
+            mxu = gen.mxu_utilization()
+            print(
+                f"util={s.utilization:.1f}% achieved={s.achieved_tflops:.1f}TFLOP/s"
+                + (f" mxu={mxu:.1f}%" if mxu is not None else "")
+                + f" steps={s.steps}",
+                flush=True,
+            )
+            last_report = time.perf_counter()
+
+
+if __name__ == "__main__":
+    main()

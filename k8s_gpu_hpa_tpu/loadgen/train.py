@@ -1,0 +1,149 @@
+"""Training load generator: ResNet-50 on synthetic CIFAR over a device mesh.
+
+BASELINE.json configs[3]: a real training pod whose utilization pattern
+(conv fwd/bwd on the MXU, BN stats, SGD update, grad allreduce over the data
+axis) drives a multi-metric HPA — a realistic step up from the matmul
+busy-loop, while remaining a *workload*, not framework machinery (the
+reference's workload is one CUDA binary, cuda-test-deployment.yaml:18-19).
+
+Sharding: batch over the ``data`` mesh axis, params replicated; XLA inserts
+the gradient psum when it partitions the jitted step (scaling-book recipe:
+pick a mesh, annotate in/out shardings, let the compiler place collectives).
+Synthetic data is generated on-device per step — no host↔device transfer in
+the steady loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.models.resnet import resnet18ish, resnet50
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+
+@dataclass
+class TrainStats:
+    steps: int
+    images_per_sec: float
+    last_loss: float
+    utilization: float  # busy fraction percent (duty-cycle analog)
+
+
+class TrainLoadGen:
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        batch_size: int = 256,
+        image_size: int = 32,
+        num_classes: int = 10,
+        small: bool = False,
+        learning_rate: float = 0.1,
+        seed: int = 0,
+    ):
+        self.mesh = mesh or make_mesh()
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.model = (
+            resnet18ish(num_classes) if small else resnet50(num_classes)
+        )
+        self.tx = optax.sgd(learning_rate, momentum=0.9)
+
+        key = jax.random.PRNGKey(seed)
+        dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+        variables = self.model.init(key, dummy, train=True)
+        replicated = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(variables["params"], replicated)
+        self.batch_stats = jax.device_put(variables["batch_stats"], replicated)
+        self.opt_state = jax.device_put(self.tx.init(self.params), replicated)
+
+        batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        def loss_fn(params, batch_stats, images, labels):
+            logits, updates = self.model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            return loss, updates["batch_stats"]
+
+        def train_step(params, batch_stats, opt_state, step_key):
+            # synthetic batch, generated sharded on-device
+            img_key, lbl_key = jax.random.split(step_key)
+            images = jax.random.normal(
+                img_key,
+                (self.batch_size, image_size, image_size, 3),
+                jnp.float32,
+            )
+            images = jax.lax.with_sharding_constraint(images, batch_sharding)
+            labels = jax.random.randint(
+                lbl_key, (self.batch_size,), 0, num_classes
+            )
+            labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, batch_stats, images, labels)
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_stats, new_opt, loss
+
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(replicated, replicated, replicated, None),
+            out_shardings=(replicated, replicated, replicated, None),
+        )
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._steps = 0
+        self._busy = 0.0
+        self._t0: float | None = None
+        self._last_loss = float("nan")
+
+    def warmup(self) -> None:
+        self.step()
+
+    def step(self) -> float:
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        self._key, step_key = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        self.params, self.batch_stats, self.opt_state, loss = self._train_step(
+            self.params, self.batch_stats, self.opt_state, step_key
+        )
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        self._busy += dt
+        self._steps += 1
+        self._last_loss = float(loss)
+        return dt
+
+    def run_for(self, seconds: float) -> TrainStats:
+        end = time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            self.step()
+        return self.stats()
+
+    def stats(self) -> TrainStats:
+        wall = (
+            time.perf_counter() - self._t0 if self._t0 is not None else 0.0
+        )
+        return TrainStats(
+            steps=self._steps,
+            images_per_sec=(
+                self._steps * self.batch_size / self._busy if self._busy else 0.0
+            ),
+            last_loss=self._last_loss,
+            utilization=min(100.0, 100.0 * self._busy / wall) if wall > 0 else 0.0,
+        )
+
+    def utilization(self, _chip_index: int = 0) -> float:
+        return self.stats().utilization

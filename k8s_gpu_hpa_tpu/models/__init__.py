@@ -1,1 +1,4 @@
-"""Placeholder: populated by the models milestone (see package docstring)."""
+from k8s_gpu_hpa_tpu.models.resnet import ResNet, resnet18ish, resnet50
+from k8s_gpu_hpa_tpu.models.tp_mlp import init_tp_mlp, tp_mlp_forward
+
+__all__ = ["ResNet", "resnet18ish", "resnet50", "init_tp_mlp", "tp_mlp_forward"]

@@ -1,0 +1,124 @@
+"""ResNet-50 in Flax — the realistic training workload of the config ladder.
+
+BASELINE.json configs[3] calls for a "JAX ResNet-50/CIFAR training pod" whose
+duty-cycle/HBM-bandwidth metrics drive a multi-metric HPA.  The reference has
+no model code at all (SURVEY.md §2c); this model exists purely as a load
+profile with realistic phases (conv-heavy fwd/bwd, BN stat updates, optimizer).
+
+TPU-first: bf16 activations with f32 parameters/BN stats (MXU-native mixed
+precision), channels-last NHWC (XLA TPU's preferred conv layout), no Python
+control flow in the traced path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    expansion: int = 4
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            use_bias=False, name="conv2",
+        )(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            self.filters * self.expansion, (1, 1), use_bias=False, name="conv3"
+        )(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion, (1, 1),
+                strides=(self.strides, self.strides),
+                use_bias=False, name="proj_conv",
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 with bottleneck blocks; ``cifar_stem`` swaps the 7x7/maxpool
+    ImageNet stem for the 3x3 stem used on 32x32 inputs."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    num_filters: int = 64
+    cifar_stem: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), use_bias=False, name="stem_conv")(x)
+        else:
+            x = conv(
+                self.num_filters, (7, 7), strides=(2, 2), use_bias=False,
+                name="stem_conv",
+            )(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if self.cifar_stem:
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2**stage,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{stage}_block{block}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in f32 for numerically stable softmax
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def resnet50(num_classes: int = 10, cifar_stem: bool = True, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        num_classes=num_classes,
+        cifar_stem=cifar_stem,
+        dtype=dtype,
+    )
+
+
+def resnet18ish(num_classes: int = 10, dtype=jnp.bfloat16) -> ResNet:
+    """Small bottleneck net for CPU-mesh tests (same code path, 1/4 depth)."""
+    return ResNet(
+        stage_sizes=(1, 1, 1, 1),
+        num_classes=num_classes,
+        num_filters=16,
+        cifar_stem=True,
+        dtype=dtype,
+    )

@@ -1,0 +1,55 @@
+"""Tensor-parallel MLP block — the model-axis sharding exemplar.
+
+Megatron-style column→row parallel pair: the first kernel is sharded over the
+``model`` axis on its output dim, the second on its input dim, so the forward
+pass needs exactly one psum at the end.  Written with ``shard_map`` so the
+collective placement is explicit (no reliance on the partitioner guessing),
+and used by the multi-chip dry-run to prove the tp axis compiles and runs
+alongside dp (the reference has no parallelism machinery, SURVEY.md §2c; this
+is load-generator machinery, not control-plane machinery).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def init_tp_mlp(key, d_model: int, d_hidden: int, mesh: Mesh, dtype=jnp.bfloat16):
+    """Params already laid out in their sharded homes: w1 column-sharded,
+    w2 row-sharded over the model axis."""
+    k1, k2 = jax.random.split(key)
+    scale1 = 1.0 / jnp.sqrt(d_model)
+    scale2 = 1.0 / jnp.sqrt(d_hidden)
+    w1 = (jax.random.normal(k1, (d_model, d_hidden)) * scale1).astype(dtype)
+    w2 = (jax.random.normal(k2, (d_hidden, d_model)) * scale2).astype(dtype)
+    return {
+        "w1": jax.device_put(w1, NamedSharding(mesh, P(None, MODEL_AXIS))),
+        "w2": jax.device_put(w2, NamedSharding(mesh, P(MODEL_AXIS, None))),
+    }
+
+
+def tp_mlp_forward(params, x, mesh: Mesh):
+    """y = gelu(x @ w1) @ w2 with batch sharded over data, hidden over model."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS), P(MODEL_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS, None),
+    )
+    def fwd(w1, w2, x):
+        h = jax.nn.gelu(
+            jnp.dot(x, w1, preferred_element_type=jnp.float32).astype(x.dtype)
+        )
+        y = jnp.dot(h, w2, preferred_element_type=jnp.float32)
+        return lax.psum(y, MODEL_AXIS).astype(x.dtype)  # the one tp collective
+
+    return fwd(params["w1"], params["w2"], x)

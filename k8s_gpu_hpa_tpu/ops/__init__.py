@@ -1,1 +1,3 @@
-"""Placeholder: populated by the ops milestone (see package docstring)."""
+from k8s_gpu_hpa_tpu.ops.pallas_matmul import matmul, matmul_pallas
+
+__all__ = ["matmul", "matmul_pallas"]

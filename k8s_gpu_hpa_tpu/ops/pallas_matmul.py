@@ -1,0 +1,100 @@
+"""Tiled bf16 matmul as a Pallas TPU kernel — the loadgen's hot op.
+
+The reference's load generator is a CUDA binary (vectorAdd,
+cuda-test-deployment.yaml:18-19); the TPU-native analog must saturate the MXU,
+and a hand-tiled Pallas matmul is the idiomatic way to own that hot loop:
+blocks sized to the 128x128 systolic array, accumulation in f32 scratch over a
+K-grid (guide: /opt/skills/guides/pallas_guide.md, tiling table and GridSpec).
+
+On non-TPU backends (the CPU test mesh) the kernel runs in interpreter mode so
+the same code path is exercised everywhere; ``matmul`` falls back to
+``jnp.dot`` when Pallas is unavailable entirely.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is backend-sensitive; degrade to jnp.dot if absent
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref):
+    """One (i, j, k) grid step: acc += A[i,k] @ B[k,j]; flush on the last k.
+
+    K is the innermost grid axis, so the f32 accumulator carries across the
+    k-steps of one (i, j) output tile (revisiting semantics), keeping partial
+    sums in VMEM scratch — bf16 inputs, f32 accumulate, the MXU-native recipe.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "block_k"))
+def matmul_pallas(
+    a: jax.Array,
+    b: jax.Array,
+    block_m: int = 256,
+    block_n: int = 256,
+    block_k: int = 512,
+) -> jax.Array:
+    """C = A @ B with MXU-aligned tiles.  Shapes must divide the block sizes
+    (the loadgen always feeds aligned shapes; static shapes keep XLA happy)."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, f"inner dims mismatch: {k} vs {k2}"
+    block_m = min(block_m, m)
+    block_n = min(block_n, n)
+    block_k = min(block_k, k)
+    assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, (
+        f"shape ({m},{k})x({k},{n}) not divisible by blocks "
+        f"({block_m},{block_n},{block_k})"
+    )
+    grid = (m // block_m, n // block_n, k // block_k)
+    interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        _matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        interpret=interpret,
+    )(a, b)
+
+
+def matmul(a: jax.Array, b: jax.Array, use_pallas: bool = True) -> jax.Array:
+    """Pallas kernel when available/aligned, else XLA's dot."""
+    if (
+        HAVE_PALLAS
+        and use_pallas
+        and a.ndim == 2
+        and b.ndim == 2
+        and a.shape[0] % 128 == 0
+        and a.shape[1] % 128 == 0
+        and b.shape[1] % 128 == 0
+    ):
+        return matmul_pallas(a, b)
+    return jnp.dot(a, b, preferred_element_type=a.dtype)

@@ -1,1 +1,17 @@
-"""Placeholder: populated by the parallel milestone (see package docstring)."""
+from k8s_gpu_hpa_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    model_sharding,
+    replicated,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "make_mesh",
+    "model_sharding",
+    "replicated",
+]

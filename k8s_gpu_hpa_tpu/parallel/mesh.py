@@ -1,0 +1,62 @@
+"""Device-mesh and sharding helpers for the load-generator workloads.
+
+The reference has no parallelism machinery at all (SURVEY.md §2c) — its scale
+axis is HPA replica count.  This rebuild keeps that architecture (the control
+plane never touches ICI) but its top-rung load generators are real multi-chip
+JAX programs (BASELINE.json configs[2-4]): data-parallel training on a v5e-8
+slice and an ICI-allreduce generator on multi-host v5p.  These helpers build
+the meshes/shardings those workloads jit over; tests exercise them on a virtual
+8-device CPU mesh (tests/conftest.py) and the driver dry-runs them multi-chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    model_parallelism: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """A 2-D ``(data, model)`` mesh over the local devices.
+
+    ``model_parallelism`` chips cooperate on one replica (tensor-parallel axis,
+    contiguous devices so the axis rides ICI neighbors on real slices); the
+    rest is the data axis.  ``model_parallelism=1`` gives pure DP — the direct
+    analog of the reference's independent single-GPU replicas
+    (cuda-test-deployment.yaml:19-22), but SPMD inside one pod.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % model_parallelism != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism={model_parallelism}"
+        )
+    grid = np.array(devices).reshape(n // model_parallelism, model_parallelism)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-sharded over the data axis (inputs, labels)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def model_sharding(mesh: Mesh, axis: int = 1, ndim: int = 2) -> NamedSharding:
+    """Weight matrices sharded over the model axis on ``axis`` — the layout
+    that turns the matmul loadgen into an ICI all-gather/reduce-scatter
+    exerciser when model_parallelism > 1."""
+    spec = [None] * ndim
+    spec[axis] = MODEL_AXIS
+    return NamedSharding(mesh, P(*spec))

@@ -11,7 +11,15 @@ process, which is why it lives at conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session environment points JAX at real TPU hardware:
+# tests must be hardware-free and deterministic.  Some TPU plugin environments
+# ignore the JAX_PLATFORMS env var, so both the env var and the config knob are
+# set (the latter must happen right after import, before any backend init).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,169 @@
+"""Manifest validation: the shipped YAML must agree with the tested engine.
+
+SURVEY.md §1's key observation is that the reference pipeline is joined only by
+string contracts (labels, metric names, port names) and breaking any one
+silently breaks the loop.  These tests make every joint explicit, and go
+further: the PrometheusRule exprs must equal the PromQL generated from the
+tested expression AST, and the shipped HPA manifest is parsed into the
+simulator and must still clear the north-star scale-up scenario."""
+
+from pathlib import Path
+
+import yaml
+
+from k8s_gpu_hpa_tpu.control.hpa import behavior_from_manifest
+from k8s_gpu_hpa_tpu.metrics.rules import tpu_test_avg_rule
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    TPU_DUTY_CYCLE,
+    TPU_HBM_BW_UTIL,
+    TPU_TENSORCORE_UTIL,
+)
+
+DEPLOY = Path(__file__).parent.parent / "deploy"
+
+
+def load(name):
+    docs = list(yaml.safe_load_all((DEPLOY / name).read_text()))
+    return docs if len(docs) > 1 else docs[0]
+
+
+def test_all_manifests_parse():
+    for f in DEPLOY.glob("*.yaml"):
+        assert load(f.name) is not None
+
+
+def test_deployment_contracts():
+    dep = load("tpu-test-deployment.yaml")
+    assert dep["kind"] == "Deployment"
+    assert "replicas" not in dep["spec"]  # HPA owns replicas (reference parity)
+    tmpl = dep["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["app"] == "tpu-test"
+    container = tmpl["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == 1
+    assert any(
+        t.get("key") == "google.com/tpu" for t in tmpl["spec"]["tolerations"]
+    )
+
+
+def test_exporter_daemonset_and_service_contracts():
+    ds, svc = load("tpu-metrics-exporter.yaml")
+    assert ds["kind"] == "DaemonSet"
+    tmpl = ds["spec"]["template"]["spec"]
+    container = tmpl["containers"][0]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["LISTEN_PORT"] == "9400"
+    assert env["COLLECT_MS"] == "1000"  # seconds-scale, fixing the 10s lag
+    # NODE_NAME via downward API
+    node_env = [e for e in container["env"] if e["name"] == "NODE_NAME"][0]
+    assert node_env["valueFrom"]["fieldRef"]["fieldPath"] == "spec.nodeName"
+    # pod-resources socket mount for attribution (dcgm parity)
+    mounts = {m["mountPath"] for m in container["volumeMounts"]}
+    assert "/var/lib/kubelet/pod-resources" in mounts
+    # service selects the daemonset and names the port "metrics"
+    assert svc["kind"] == "Service"
+    assert (
+        svc["spec"]["selector"]["app.kubernetes.io/name"]
+        == ds["spec"]["template"]["metadata"]["labels"]["app.kubernetes.io/name"]
+    )
+    assert svc["spec"]["ports"][0]["name"] == "metrics"
+    assert svc["spec"]["ports"][0]["port"] == 9400
+
+
+def test_scrape_config_binds_service_and_relabels_node():
+    values = load("kube-prometheus-stack-values.yaml")
+    jobs = values["prometheus"]["prometheusSpec"]["additionalScrapeConfigs"]
+    job = [j for j in jobs if j["job_name"] == "tpu-metrics"][0]
+    assert job["scrape_interval"] == "1s"  # reference parity
+    keeps = [r for r in job["relabel_configs"] if r.get("action") == "keep"]
+    assert any(r["regex"] == "tpu-metrics-exporter" for r in keeps)
+    assert any(r["regex"] == "metrics" for r in keeps)
+    node_relabel = [
+        r for r in job["relabel_configs"] if r.get("target_label") == "node"
+    ][0]
+    assert node_relabel["source_labels"] == ["__meta_kubernetes_pod_node_name"]
+
+
+def test_prometheusrule_exprs_generated_from_ast():
+    """The single-source-of-truth check: YAML expr == AST promql, all rules."""
+    rule_doc = load("tpu-test-prometheusrule.yaml")
+    assert rule_doc["metadata"]["labels"]["release"] == "kube-prometheus-stack"
+    rules = {
+        r["record"]: r for r in rule_doc["spec"]["groups"][0]["rules"]
+    }
+    expected = {
+        "tpu_test_tensorcore_avg": TPU_TENSORCORE_UTIL,
+        "tpu_test_duty_cycle_avg": TPU_DUTY_CYCLE,
+        "tpu_test_hbm_bw_avg": TPU_HBM_BW_UTIL,
+    }
+    assert set(rules) == set(expected)
+    for record, metric in expected.items():
+        ast_rule = tpu_test_avg_rule(metric=metric, record=record)
+        assert rules[record]["expr"] == ast_rule.expr.promql(), record
+        assert rules[record]["labels"] == ast_rule.labels
+
+
+def test_adapter_rules_cover_all_recorded_series():
+    adapter = load("prometheus-adapter-values.yaml")
+    assert adapter["rules"]["default"] is False  # explicit rules only
+    series = {r["name"]["as"] for r in adapter["rules"]["custom"]}
+    rule_doc = load("tpu-test-prometheusrule.yaml")
+    recorded = {r["record"] for r in rule_doc["spec"]["groups"][0]["rules"]}
+    assert series == recorded
+    for r in adapter["rules"]["custom"]:
+        overrides = r["resources"]["overrides"]
+        assert overrides["namespace"] == {"resource": "namespace"}
+        assert overrides["deployment"] == {"resource": "deployment"}
+
+
+def test_hpa_contracts():
+    hpa = load("tpu-test-hpa.yaml")
+    assert hpa["apiVersion"] == "autoscaling/v2"  # behavior needs v2 (not v2beta1)
+    spec = hpa["spec"]
+    assert spec["scaleTargetRef"]["name"] == "tpu-test"
+    assert (spec["minReplicas"], spec["maxReplicas"]) == (1, 4)
+    metric = spec["metrics"][0]["object"]
+    assert metric["metric"]["name"] == "tpu_test_tensorcore_avg"
+    assert metric["describedObject"]["name"] == "tpu-test"
+    assert float(metric["target"]["value"]) == 40.0
+
+
+def test_shipped_hpa_clears_north_star_in_simulation():
+    """Parse the real manifest's behavior+target into the closed-loop sim:
+    1->4 within 60s of the metric crossing 40 (BASELINE.md), and no flapping
+    afterwards even though shared load redistributes."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    hpa_doc = load("tpu-test-hpa.yaml")
+    behavior = behavior_from_manifest(hpa_doc)
+    target_value = float(
+        hpa_doc["spec"]["metrics"][0]["object"]["target"]["value"]
+    )
+
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("tpu-node-0", 8)], pod_start_latency=12.0)
+    deployment = SimDeployment(
+        cluster,
+        name="tpu-test",
+        app_label="tpu-test",
+        load_fn=lambda t: 640.0 if t >= 100.0 else 20.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(deployment, replicas=1)
+    clock.advance(15.0)
+    pipeline = AutoscalingPipeline(
+        cluster,
+        deployment,
+        target_value=target_value,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior,
+    )
+    pipeline.run_for(160.0)
+    assert pipeline.replicas() == 4
+    assert all(ts <= 160.0 for ts, _, _ in pipeline.scale_history)
+    # steady afterwards: no events in the tail window
+    pipeline.run_for(300.0)
+    late = [e for e in pipeline.scale_history if e[0] > 200.0]
+    assert late == []

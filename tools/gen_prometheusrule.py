@@ -1,0 +1,87 @@
+"""Regenerate deploy/tpu-test-prometheusrule.yaml from the tested rule ASTs.
+
+The recording rules' PromQL is defined once, in
+k8s_gpu_hpa_tpu/metrics/rules.py (the same expressions the closed-loop tests
+evaluate in-process); this script renders the manifest so the two can never
+drift.  tests/test_manifests.py fails if the file on disk disagrees.
+
+Usage: python tools/gen_prometheusrule.py [--check]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_gpu_hpa_tpu.metrics.rules import tpu_test_avg_rule
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    TPU_DUTY_CYCLE,
+    TPU_HBM_BW_UTIL,
+    TPU_TENSORCORE_UTIL,
+)
+
+HEADER = """\
+# L3 recording rule: defines the autoscale metric.
+# Analog of cuda-test-prometheusrule.yaml with the same three load-bearing
+# tricks (SURVEY.md §3.2): max-by-pod collapse (here also collapsing the chips
+# of a multi-chip slice pod), the kube_pod_labels inner join that scopes
+# device metrics to one app, and the hard-coded namespace/deployment output
+# labels that let prometheus-adapter address the series as an Object metric.
+#
+# The expr strings are GENERATED from the tested expression AST
+# (k8s_gpu_hpa_tpu/metrics/rules.py::tpu_test_avg_rule); tests/test_manifests.py
+# fails if this file and the engine ever disagree.
+apiVersion: monitoring.coreos.com/v1
+kind: PrometheusRule
+metadata:
+  name: tpu-test
+  labels:
+    # the Prometheus operator only selects rules carrying the release label
+    # (same trap as the reference, cuda-test-prometheusrule.yaml:6)
+    release: kube-prometheus-stack
+spec:
+  groups:
+    - name: tpu-test
+      interval: 1s
+      rules:
+"""
+
+RULES = [
+    ("tpu_test_tensorcore_avg", TPU_TENSORCORE_UTIL, None),
+    ("tpu_test_duty_cycle_avg", TPU_DUTY_CYCLE,
+     "# additional rungs for the multi-metric HPA (BASELINE configs[3])"),
+    ("tpu_test_hbm_bw_avg", TPU_HBM_BW_UTIL, None),
+]
+
+
+def render() -> str:
+    out = [HEADER]
+    for record, metric, comment in RULES:
+        rule = tpu_test_avg_rule(metric=metric, record=record)
+        if comment:
+            out.append(f"        {comment}\n")
+        out.append(f"        - record: {rule.record}\n")
+        out.append(f"          expr: {rule.expr.promql()}\n")
+        out.append("          labels:\n")
+        for k, v in rule.labels.items():
+            out.append(f"            {k}: {v}\n")
+    return "".join(out)
+
+
+def main() -> None:
+    target = Path(__file__).resolve().parent.parent / "deploy/tpu-test-prometheusrule.yaml"
+    content = render()
+    if "--check" in sys.argv:
+        if target.read_text() != content:
+            print(f"{target} is stale; rerun tools/gen_prometheusrule.py")
+            sys.exit(1)
+        print("up to date")
+        return
+    target.write_text(content)
+    print(f"wrote {target}")
+
+
+if __name__ == "__main__":
+    main()
