@@ -1,0 +1,163 @@
+"""Stub libtpu runtime-metrics gRPC server.
+
+The hardware-free stand-in for the service libtpu runs on TPU nodes at
+localhost:8431 (the acquisition source the production exporter reads,
+sources.LibtpuSource).  SURVEY.md §4 calls for exactly this: "a stub gRPC
+metrics server mimicking localhost:8431" so the exporter's libtpu path has
+tests that don't need a TPU node — the reference's dcgm-exporter has no such
+story for DCGM (its tests require a GPU driver).
+
+The stub serves the same method name and wire shape LibtpuSource consumes
+(`/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric`); values come
+from a ``metric_fn(metric_name, device_id) -> float`` so tests can script
+utilization curves per chip, like StubSource does for the in-process path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from k8s_gpu_hpa_tpu.exporter import sources
+from k8s_gpu_hpa_tpu.utils import protowire
+
+GET_METRIC_METHOD = (
+    "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric"
+)
+
+
+def decode_metric_request(data: bytes) -> str:
+    """MetricRequest.metric_name (field 1, string)."""
+    names = protowire.fields_by_number(data).get(1, [])
+    return names[0].decode() if names else ""
+
+
+def encode_metric_response(
+    name: str, per_device: dict[int, float], as_int: bool = False
+) -> bytes:
+    """Encode the MetricResponse wire shape parse_metric_response decodes:
+
+        MetricResponse { TPUMetric metric = 1; }
+        TPUMetric { string name = 1; repeated Metric metrics = 2; }
+        Metric { Attribute attribute = 1; Gauge gauge = 2; }
+        Attribute { string key = 1; AttrValue value = 2; }
+        AttrValue { int64 int_attr = 2; }
+        Gauge { double as_double = 1; int64 as_int = 2; }
+    """
+    metrics = b""
+    for device_id, value in sorted(per_device.items()):
+        attr_value = protowire.encode_uint(2, device_id)
+        attribute = protowire.encode_string(1, "device-id") + protowire.encode_string(
+            2, attr_value
+        )
+        if as_int:
+            gauge = protowire.encode_uint(2, int(value))
+        else:
+            gauge = protowire.encode_double(1, float(value))
+        metric = protowire.encode_string(1, attribute) + protowire.encode_string(
+            2, gauge
+        )
+        metrics += protowire.encode_string(2, metric)
+    tpu_metric = protowire.encode_string(1, name) + metrics
+    return protowire.encode_string(1, tpu_metric)
+
+
+@dataclass
+class StubLibtpuServer:
+    """In-process gRPC server speaking the libtpu runtime-metrics protocol.
+
+    ``metric_fn(metric_name, device_id)`` supplies every value; HBM totals are
+    static by default.  ``request_log`` records the metric names queried, so
+    tests can assert the client's exact wire traffic.
+    """
+
+    num_chips: int = 4
+    metric_fn: Callable[[str, int], float] | None = None
+    hbm_total: float = 16e9
+    request_log: list[str] = field(default_factory=list)
+    port: int = 0
+
+    def _value(self, name: str, device_id: int) -> float:
+        if self.metric_fn is not None:
+            return self.metric_fn(name, device_id)
+        if name == sources.LIBTPU_DUTY_CYCLE:
+            return 50.0
+        if name == sources.LIBTPU_HBM_USAGE:
+            return 0.5 * self.hbm_total
+        if name == sources.LIBTPU_HBM_TOTAL:
+            return self.hbm_total
+        return 0.0
+
+    def _handle(self, request: bytes, context) -> bytes:
+        name = decode_metric_request(request)
+        self.request_log.append(name)
+        per_device = {i: self._value(name, i) for i in range(self.num_chips)}
+        # libtpu reports HBM byte counts as int64 gauges, percentages as
+        # doubles; serve both encodings so the client's dual decode is covered.
+        as_int = name in (sources.LIBTPU_HBM_USAGE, sources.LIBTPU_HBM_TOTAL)
+        return encode_metric_response(name, per_device, as_int=as_int)
+
+    def start(self) -> "StubLibtpuServer":
+        import grpc
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(handler_self, call_details):
+                if call_details.method != GET_METRIC_METHOD:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    self._handle,
+                    request_deserializer=lambda raw: raw,
+                    response_serializer=lambda raw: raw,
+                )
+
+        from concurrent import futures
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2),
+            # without this, Linux SO_REUSEPORT lets a second stub silently
+            # share the port and steal a fraction of the client's RPCs
+            options=[("grpc.so_reuseport", 0)],
+        )
+        self._server.add_generic_rpc_handlers((Handler(),))
+        bound = self._server.add_insecure_port(f"localhost:{self.port}")
+        if bound == 0:  # grpc signals bind failure by returning port 0
+            raise OSError(f"could not bind stub libtpu server to port {self.port}")
+        self.port = bound
+        self._server.start()
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self.port}"
+
+    def stop(self) -> None:
+        if getattr(self, "_server", None) is not None:
+            # wait for the listener to actually close so the port is
+            # immediately rebindable (restart tests reuse it)
+            self._server.stop(grace=0).wait()
+            self._server = None
+
+    def __enter__(self) -> "StubLibtpuServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main() -> None:
+    """Run the stub on :8431 — lets the full exporter container run its
+    production SOURCE=libtpu path on a machine with no TPU."""
+    import os
+    import time
+
+    server = StubLibtpuServer(
+        num_chips=int(os.environ.get("STUB_CHIPS", "4")),
+        port=int(os.environ.get("STUB_PORT", "8431")),
+    ).start()
+    print(f"stub libtpu metrics server on {server.address}", flush=True)
+    while True:
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()

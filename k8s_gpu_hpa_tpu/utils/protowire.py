@@ -64,6 +64,16 @@ def encode_string(field: int, value: str | bytes) -> bytes:
     return encode_tag(field, BYTES) + encode_varint(len(raw)) + raw
 
 
+def encode_uint(field: int, value: int) -> bytes:
+    """Encode a non-negative int as a varint field."""
+    return encode_tag(field, VARINT) + encode_varint(value)
+
+
+def encode_double(field: int, value: float) -> bytes:
+    """Encode a float as a fixed64 IEEE-double field."""
+    return encode_tag(field, FIXED64) + struct.pack("<d", value)
+
+
 def decode_fields(data: bytes) -> list[tuple[int, int, int | bytes]]:
     """Decode a message into (field_number, wire_type, value) records.
     Varint/fixed values come back as ints, length-delimited as bytes."""

@@ -1,0 +1,105 @@
+"""The libtpu acquisition path, end-to-end with no TPU node.
+
+SURVEY.md §4's rebuild implication: "only the L2 exporter's libtpu reader needs
+hardware (or a stub gRPC metrics server mimicking localhost:8431)".  This is
+that stub, exercised the way production uses the real one: LibtpuSource speaks
+actual gRPC over TCP to StubLibtpuServer, and the full daemon serves what it
+read on /metrics."""
+
+import urllib.request
+
+import pytest
+
+from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+from k8s_gpu_hpa_tpu.exporter.native import build_native
+from k8s_gpu_hpa_tpu.exporter.sources import (
+    LIBTPU_DUTY_CYCLE,
+    LIBTPU_HBM_TOTAL,
+    LIBTPU_HBM_USAGE,
+    LibtpuSource,
+    parse_metric_response,
+)
+from k8s_gpu_hpa_tpu.exporter.stub_libtpu import (
+    StubLibtpuServer,
+    decode_metric_request,
+    encode_metric_response,
+)
+from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+from k8s_gpu_hpa_tpu.metrics.schema import TPU_DUTY_CYCLE, TPU_HBM_USAGE
+from k8s_gpu_hpa_tpu.utils import protowire
+
+
+def test_request_wire_roundtrip():
+    # the exact request bytes LibtpuSource sends (sources.py _get_metric)
+    request = protowire.encode_string(1, LIBTPU_DUTY_CYCLE)
+    assert decode_metric_request(request) == LIBTPU_DUTY_CYCLE
+
+
+@pytest.mark.parametrize("as_int", [False, True])
+def test_response_wire_roundtrip(as_int):
+    values = {0: 12.0, 1: 99.0, 7: 3.0}
+    data = encode_metric_response("m", values, as_int=as_int)
+    assert parse_metric_response(data) == values
+
+
+def test_source_reads_stub_over_grpc():
+    curves = {LIBTPU_DUTY_CYCLE: {0: 30.0, 1: 90.0}}
+    with StubLibtpuServer(
+        num_chips=2,
+        metric_fn=lambda name, i: curves.get(name, {}).get(i, 8e9),
+    ) as server:
+        source = LibtpuSource(address=server.address)
+        chips = source.sample()
+        source.close()
+    assert [c.accel_index for c in chips] == [0, 1]
+    assert chips[0].tensorcore_util == 30.0
+    assert chips[1].duty_cycle == 90.0
+    assert chips[0].hbm_usage_bytes == 8e9
+    # one GetRuntimeMetric per metric per sweep
+    assert server.request_log == [
+        LIBTPU_DUTY_CYCLE,
+        LIBTPU_HBM_USAGE,
+        LIBTPU_HBM_TOTAL,
+    ]
+
+
+def test_source_recovers_after_server_restart():
+    """A wedged/restarted libtpu must not kill the daemon permanently: the
+    source drops its channel on error and reconnects on the next sweep."""
+    server = StubLibtpuServer(num_chips=1).start()
+    source = LibtpuSource(address=server.address, timeout=1.0)
+    assert len(source.sample()) == 1
+    port = server.port
+    server.stop()
+    with pytest.raises(Exception):
+        source.sample()
+    server = StubLibtpuServer(num_chips=1, port=port).start()
+    try:
+        assert len(source.sample()) == 1
+    finally:
+        source.close()
+        server.stop()
+
+
+def test_daemon_serves_stub_libtpu_metrics_over_http():
+    """Production wiring end-to-end: stub 8431 → gRPC → LibtpuSource → C++
+    core → /metrics text, the automated analog of the reference's exporter
+    curl probe (README.md:42-47)."""
+    build_native()
+    with StubLibtpuServer(num_chips=2) as server:
+        source = LibtpuSource(address=server.address)
+        with ExporterDaemon(
+            source, node_name="tpu-node-0", listen_addr="127.0.0.1", port=0
+        ) as daemon:
+            daemon.step()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.port}/metrics", timeout=5
+            ) as r:
+                body = r.read().decode()
+        source.close()
+    fams = {f.name: f for f in parse_text(body)}
+    duty = {s.label("chip"): s.value for s in fams[TPU_DUTY_CYCLE].samples}
+    assert duty == {"0": 50.0, "1": 50.0}
+    usage = {s.label("chip"): s.value for s in fams[TPU_HBM_USAGE].samples}
+    assert usage == {"0": 8e9, "1": 8e9}
+    assert 'tpu_metrics_exporter_up{node="tpu-node-0"} 1' in body
