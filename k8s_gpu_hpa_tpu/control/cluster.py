@@ -70,6 +70,8 @@ class SimDeployment:
         namespace: str = "default",
         load_fn: Callable[[float], float] | None = None,
         load_mode: str = "shared",  # "shared" | "per_pod"
+        hosts_per_slice: int = 1,
+        barrier_idle_util: float = 2.0,
     ):
         self.cluster = cluster
         self.name = name
@@ -79,6 +81,13 @@ class SimDeployment:
         self.load_fn = load_fn or (lambda t: 0.0)
         assert load_mode in ("shared", "per_pod")
         self.load_mode = load_mode
+        # Multi-host slices (BASELINE configs[4]): `replicas` counts pods
+        # (hosts), but one SPMD workload replica is `hosts_per_slice` pods.
+        # Hosts of an incomplete slice sit at the jax.distributed init
+        # barrier — near-idle, and contributing nothing — which is exactly
+        # why the HPA needs replica_quantum (control/hpa.py).
+        self.hosts_per_slice = hosts_per_slice
+        self.barrier_idle_util = barrier_idle_util
         self.replicas = 0
 
     def scale_to(self, replicas: int) -> None:
@@ -88,9 +97,18 @@ class SimDeployment:
     def pod_utilization(self, pod: SimPod) -> float:
         """Current tensorcore utilization percent for one running pod."""
         offered = self.load_fn(self.cluster.clock.now())
+        running = self.cluster.running_pods(self.name)
+        if self.hosts_per_slice > 1:
+            ordered = sorted(running, key=lambda p: (p.created_at, p.name))
+            n_slices = len(ordered) // self.hosts_per_slice
+            active = ordered[: n_slices * self.hosts_per_slice]
+            if pod not in active:
+                return self.barrier_idle_util
+            if self.load_mode == "per_pod":
+                return min(100.0, offered)
+            return min(100.0, offered / n_slices)
         if self.load_mode == "per_pod":
             return min(100.0, offered)
-        running = self.cluster.running_pods(self.name)
         if not running:
             return 0.0
         return min(100.0, offered / len(running))

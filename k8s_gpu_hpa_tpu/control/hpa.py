@@ -122,6 +122,13 @@ def behavior_from_manifest(hpa_doc: dict) -> HPABehavior:
     return behavior
 
 
+def quantum_from_manifest(hpa_doc: dict) -> int:
+    """Slice-atomicity quantum from the ``k8s-tpu-hpa/replica-quantum``
+    annotation (deploy/tpu-test-multihost-hpa.yaml); 1 when absent."""
+    annotations = hpa_doc.get("metadata", {}).get("annotations", {})
+    return int(annotations.get("k8s-tpu-hpa/replica-quantum", 1))
+
+
 class HPAController:
     """One HPA object + its sync loop (kube-controller-manager syncs every 15 s
     by default; SURVEY.md §3.3)."""
@@ -139,6 +146,7 @@ class HPAController:
         behavior: HPABehavior | None = None,
         sync_interval: float = 15.0,
         on_scale: Callable[[int, int], None] | None = None,
+        replica_quantum: int = 1,
     ):
         self.target = target
         self.metrics = metrics
@@ -149,6 +157,20 @@ class HPAController:
         self.behavior = behavior or HPABehavior()
         self.sync_interval = sync_interval
         self.on_scale = on_scale
+        # Slice atomicity (SURVEY.md §7(d)): on multi-host slices one logical
+        # replica is `hosts_per_slice` pods, and a partial slice contributes
+        # zero capacity (its hosts block at the distributed-init barrier), so
+        # replicas must move in whole-slice quanta.  Vanilla HPA has no such
+        # knob — this is the TPU-native extension the StatefulSet-of-slices
+        # design (deploy/tpu-test-multihost.yaml) relies on.
+        if replica_quantum < 1:
+            raise ValueError("replica_quantum must be >= 1")
+        if replica_quantum > 1 and max_replicas < replica_quantum:
+            raise ValueError(
+                f"max_replicas={max_replicas} cannot fit one slice of "
+                f"replica_quantum={replica_quantum} pods"
+            )
+        self.replica_quantum = replica_quantum
         self.status = HPAStatus(current_replicas=target.replicas)
         #: (ts, recommendation) ring for stabilization windows
         self._recommendations: list[tuple[float, int]] = []
@@ -250,6 +272,26 @@ class HPAController:
             reason = "within tolerance / stabilized"
 
         desired = min(max(desired, self.min_replicas), self.max_replicas)
+        q = self.replica_quantum
+        if q > 1:
+            # Round up when growing (a partial slice serves nothing, so the
+            # policy step may be exceeded by < one quantum; rounding down
+            # instead could deadlock against a tight policy forever), down
+            # when shrinking (never tear half a slice).  Bounds that aren't
+            # slice multiples would themselves strand a partial slice; snap
+            # them inward (the constructor guarantees max_replicas >= q).
+            max_q = self.max_replicas // q * q
+            min_q = min(math.ceil(self.min_replicas / q) * q, max_q)
+            if desired > current:
+                desired = min(math.ceil(desired / q) * q, max_q)
+            elif desired < current:
+                desired = max(desired // q * q, min_q)
+            elif desired % q:
+                # current count is itself a partial slice (operator kubectl-
+                # scaled, or the HPA adopted a misaligned target): repair by
+                # releasing the stranded hosts — they serve nothing anyway.
+                desired = max(desired // q * q, min_q)
+                reason = f"repair partial slice {current}->{desired}"
         self.status.desired_replicas = desired
         self.status.last_reason = reason
 

@@ -22,7 +22,12 @@ from dataclasses import dataclass
 from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
 from k8s_gpu_hpa_tpu.control.hpa import HPABehavior, HPAController, ObjectMetricSpec
-from k8s_gpu_hpa_tpu.metrics.rules import RecordingRule, RuleEvaluator, tpu_test_avg_rule
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    RecordingRule,
+    RuleEvaluator,
+    tpu_test_avg_rule,
+    tpu_test_multihost_avg_rule,
+)
 from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
 from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
 
@@ -49,6 +54,8 @@ class AutoscalingPipeline:
         behavior: HPABehavior | None = None,
         intervals: PipelineIntervals | None = None,
         extra_rules: list[RecordingRule] | None = None,
+        replica_quantum: int = 1,
+        object_kind: str = "Deployment",  # "Deployment" | "StatefulSet"
     ):
         self.cluster = cluster
         self.deployment = deployment
@@ -65,21 +72,32 @@ class AutoscalingPipeline:
             )
         self.scraper.add_target(cluster.kube_state_metrics_text, name="kube-state-metrics")
 
-        rules = [
-            tpu_test_avg_rule(
+        if object_kind == "StatefulSet":
+            # multi-host rung: the series is addressed at the StatefulSet
+            primary = tpu_test_multihost_avg_rule(
+                app=deployment.app_label,
+                statefulset=deployment.name,
+                namespace=deployment.namespace,
+                record=record,
+            )
+            overrides = {"namespace": "namespace", "statefulset": "StatefulSet"}
+        else:
+            primary = tpu_test_avg_rule(
                 app=deployment.app_label,
                 deployment=deployment.name,
                 namespace=deployment.namespace,
                 record=record,
             )
-        ] + (extra_rules or [])
+            overrides = {"namespace": "namespace", "deployment": "Deployment"}
+        rules = [primary] + (extra_rules or [])
         self.evaluator = RuleEvaluator(self.db, rules, interval=self.intervals.rule_eval)
 
         self.adapter = CustomMetricsAdapter(
-            self.db, [AdapterRule(series=r.record) for r in rules]
+            self.db,
+            [AdapterRule(series=r.record, resource_overrides=overrides) for r in rules],
         )
 
-        ref = ObjectReference("Deployment", deployment.name, deployment.namespace)
+        ref = ObjectReference(object_kind, deployment.name, deployment.namespace)
         self.hpa = HPAController(
             target=deployment,
             metrics=[ObjectMetricSpec(record, target_value, ref)],
@@ -89,6 +107,7 @@ class AutoscalingPipeline:
             max_replicas=max_replicas,
             behavior=behavior,
             sync_interval=self.intervals.hpa_sync,
+            replica_quantum=replica_quantum,
         )
         self.scale_history: list[tuple[float, int, int]] = []  # (ts, from, to)
         self.hpa.on_scale = lambda a, b: self.scale_history.append((clock.now(), a, b))

@@ -1,0 +1,188 @@
+"""Multi-host slice wiring: topology resolution + ``jax.distributed`` init.
+
+BASELINE.json configs[4] tops the ladder with a "v5p-16 multi-host pod-slice,
+ICI allreduce load-gen": one *logical* workload replica is a slice spanning
+several hosts, each host a pod running one JAX process over the slice's chips.
+The reference never has this axis (its replicas are isolated 1-GPU pods,
+SURVEY.md §2c); it is the genuinely TPU-native scaling rung, and SURVEY.md
+§7(d) calls out its control-plane consequence: HPA replicas must move in
+whole-slice quanta (see control/hpa.py ``replica_quantum``).
+
+Topology is resolved from the environment, in precedence order:
+
+1. **Explicit** — ``COORDINATOR_ADDRESS`` + ``NUM_PROCESSES`` + ``PROCESS_ID``
+   (the generic ``jax.distributed`` contract; works on any orchestrator).
+2. **GKE TPU webhook** — ``TPU_WORKER_HOSTNAMES`` (comma-separated) +
+   ``TPU_WORKER_ID``, the variables GKE injects on multi-host TPU node pools.
+3. **StatefulSet convention** (deploy/tpu-test-multihost.yaml) —
+   ``HOSTS_PER_SLICE`` + ``HEADLESS_SERVICE``: pod ordinal ``N`` in
+   ``<name>-N`` maps to slice ``N // hosts`` and worker ``N % hosts``; the
+   slice coordinator is the slice's worker-0 pod through the headless
+   service's per-pod DNS.  This is what lets a *single* StatefulSet hold
+   many slices and scale by whole slices under the HPA.
+
+Pure functions do the resolution (unit-testable with fake env/hostnames);
+``initialize()`` applies it to ``jax.distributed``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Mapping
+
+#: jax's default coordinator port; overridable via COORDINATOR_PORT.
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """One host's place in a multi-host slice."""
+
+    process_id: int  # global JAX process index within the slice
+    num_processes: int  # hosts per slice
+    coordinator_address: str  # host:port of the slice's process 0
+    slice_index: int = 0  # which slice replica this host belongs to
+
+    @property
+    def worker_index(self) -> int:
+        return self.process_id
+
+
+def pod_ordinal(hostname: str) -> int | None:
+    """StatefulSet pods are named ``<set>-<ordinal>``."""
+    base, sep, tail = hostname.rpartition("-")
+    if sep and base and tail.isdigit():
+        return int(tail)
+    return None
+
+
+def topology_from_env(
+    env: Mapping[str, str] | None = None, hostname: str | None = None
+) -> HostTopology | None:
+    """Resolve this host's topology; ``None`` means single-process."""
+    env = os.environ if env is None else env
+    hostname = hostname if hostname is not None else socket.gethostname()
+    port = int(env.get("COORDINATOR_PORT", DEFAULT_COORDINATOR_PORT))
+
+    if "COORDINATOR_ADDRESS" in env:
+        return HostTopology(
+            process_id=int(env.get("PROCESS_ID", env.get("TPU_WORKER_ID", "0"))),
+            num_processes=int(env.get("NUM_PROCESSES", "1")),
+            coordinator_address=env["COORDINATOR_ADDRESS"],
+            slice_index=int(env.get("SLICE_INDEX", "0")),
+        )
+
+    if env.get("TPU_WORKER_HOSTNAMES"):  # empty string = single-host pool
+        hosts = [h for h in env["TPU_WORKER_HOSTNAMES"].split(",") if h]
+        if hosts:
+            return HostTopology(
+                process_id=int(env.get("TPU_WORKER_ID", "0")),
+                num_processes=len(hosts),
+                coordinator_address=f"{hosts[0]}:{port}",
+                slice_index=int(env.get("SLICE_INDEX", "0")),
+            )
+
+    if "HOSTS_PER_SLICE" in env:
+        hosts_per_slice = int(env["HOSTS_PER_SLICE"])
+        if hosts_per_slice <= 1:
+            return None
+        ordinal = pod_ordinal(hostname)
+        if ordinal is None:
+            raise ValueError(
+                f"HOSTS_PER_SLICE set but hostname {hostname!r} has no "
+                "StatefulSet ordinal suffix"
+            )
+        slice_index = ordinal // hosts_per_slice
+        base = hostname[: hostname.rfind("-")]
+        coordinator_pod = f"{base}-{slice_index * hosts_per_slice}"
+        service = env.get("HEADLESS_SERVICE", base)
+        namespace = env.get("POD_NAMESPACE", "default")
+        return HostTopology(
+            process_id=ordinal % hosts_per_slice,
+            num_processes=hosts_per_slice,
+            # per-pod DNS through the headless service
+            coordinator_address=(
+                f"{coordinator_pod}.{service}.{namespace}.svc.cluster.local:{port}"
+            ),
+            slice_index=slice_index,
+        )
+
+    return None
+
+
+def initialize(topology: HostTopology | None = None) -> HostTopology | None:
+    """Bring up ``jax.distributed`` for this host's slice (idempotent-ish:
+    call once, before any backend use).  Returns the resolved topology."""
+    import jax
+
+    if topology is None:
+        topology = topology_from_env()
+    if topology is None or topology.num_processes <= 1:
+        return topology
+    jax.distributed.initialize(
+        coordinator_address=topology.coordinator_address,
+        num_processes=topology.num_processes,
+        process_id=topology.process_id,
+    )
+    return topology
+
+
+def main() -> None:
+    """``python -m k8s_gpu_hpa_tpu.loadgen.multihost`` — the multi-host slice
+    container command: init the slice, then drive ICI collectives with the
+    same runtime intensity knob as the single-chip generator."""
+    import time
+
+    import jax
+
+    from k8s_gpu_hpa_tpu.loadgen.allreduce import AllReduceLoadGen
+    from k8s_gpu_hpa_tpu.loadgen.matmul import (
+        DEFAULT_INTENSITY_FILE,
+        INTENSITY_ENV,
+        INTENSITY_FILE_ENV,
+    )
+    from k8s_gpu_hpa_tpu.parallel.mesh import make_mesh
+
+    topology = initialize()
+    mesh = make_mesh()
+    gen = AllReduceLoadGen(
+        mesh=mesh, buffer_mb=float(os.environ.get("BUFFER_MB", "64"))
+    )
+    gen.warmup()
+    intensity_file = os.environ.get(INTENSITY_FILE_ENV, DEFAULT_INTENSITY_FILE)
+    intensity = float(os.environ.get(INTENSITY_ENV, "1.0"))
+    report_every = float(os.environ.get("REPORT_S", "10"))
+    print(
+        f"tpu-test multihost loadgen: process {jax.process_index()}/"
+        f"{jax.process_count()} slice="
+        f"{topology.slice_index if topology else 0} mesh={dict(mesh.shape)} "
+        f"(knob: {intensity_file})",
+        flush=True,
+    )
+    last_report = time.perf_counter()
+    while True:
+        try:
+            with open(intensity_file) as f:
+                intensity = max(0.0, min(1.0, float(f.read().strip())))
+        except (OSError, ValueError):
+            pass  # file absent or mid-write: keep current intensity
+        if intensity <= 0.0:
+            time.sleep(0.05)
+        else:
+            busy = gen.step()
+            if intensity < 1.0:
+                time.sleep(busy * (1.0 - intensity) / intensity)
+        if time.perf_counter() - last_report >= report_every:
+            s = gen.stats()
+            print(
+                f"rounds={s.rounds} ici={s.achieved_gbps:.1f}GB/s "
+                f"busy={s.seconds:.1f}s",
+                flush=True,
+            )
+            last_report = time.perf_counter()
+
+
+if __name__ == "__main__":
+    main()

@@ -217,3 +217,36 @@ def tpu_test_avg_rule(
         expr=expr,
         labels={"namespace": namespace, "deployment": deployment},
     )
+
+
+def tpu_test_multihost_avg_rule(
+    app: str = "tpu-test-multihost",
+    statefulset: str = "tpu-test-multihost",
+    namespace: str = "default",
+    metric: str = TPU_TENSORCORE_UTIL,
+    record: str = "tpu_test_multihost_tensorcore_avg",
+) -> RecordingRule:
+    """The multi-host rung (BASELINE configs[4]): same three-trick shape, but
+    the workload is a StatefulSet of slices (deploy/tpu-test-multihost.yaml) —
+    each HPA "pod" is one host of a multi-host slice, every host runs the same
+    SPMD program, and per-host exporters each see only their local chips.  The
+    avg over per-pod maxima is therefore the avg across all hosts of all
+    slices, which equals the per-slice average when slices are equal-sized —
+    the aggregation SURVEY.md §7(c) flags as the axis the reference never had.
+    Output labels address the series at the StatefulSet object."""
+    expr = Avg(
+        MulOnGroupLeft(
+            left=MaxBy(("node", "pod", "namespace"), Select(metric)),
+            right=MaxBy(
+                ("pod", "label_app"),
+                Select("kube_pod_labels", {"label_app": app}),
+            ),
+            on=("pod",),
+            group_left=("label_app",),
+        )
+    )
+    return RecordingRule(
+        record=record,
+        expr=expr,
+        labels={"namespace": namespace, "statefulset": statefulset},
+    )

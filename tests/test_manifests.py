@@ -11,8 +11,11 @@ from pathlib import Path
 
 import yaml
 
-from k8s_gpu_hpa_tpu.control.hpa import behavior_from_manifest
-from k8s_gpu_hpa_tpu.metrics.rules import tpu_test_avg_rule
+from k8s_gpu_hpa_tpu.control.hpa import behavior_from_manifest, quantum_from_manifest
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    tpu_test_avg_rule,
+    tpu_test_multihost_avg_rule,
+)
 from k8s_gpu_hpa_tpu.metrics.schema import (
     TPU_DUTY_CYCLE,
     TPU_HBM_BW_UTIL,
@@ -87,9 +90,8 @@ def test_prometheusrule_exprs_generated_from_ast():
     """The single-source-of-truth check: YAML expr == AST promql, all rules."""
     rule_doc = load("tpu-test-prometheusrule.yaml")
     assert rule_doc["metadata"]["labels"]["release"] == "kube-prometheus-stack"
-    rules = {
-        r["record"]: r for r in rule_doc["spec"]["groups"][0]["rules"]
-    }
+    groups = {g["name"]: g for g in rule_doc["spec"]["groups"]}
+    rules = {r["record"]: r for r in groups["tpu-test"]["rules"]}
     expected = {
         "tpu_test_tensorcore_avg": TPU_TENSORCORE_UTIL,
         "tpu_test_duty_cycle_avg": TPU_DUTY_CYCLE,
@@ -100,6 +102,11 @@ def test_prometheusrule_exprs_generated_from_ast():
         ast_rule = tpu_test_avg_rule(metric=metric, record=record)
         assert rules[record]["expr"] == ast_rule.expr.promql(), record
         assert rules[record]["labels"] == ast_rule.labels
+    mh = groups["tpu-test-multihost"]["rules"][0]
+    mh_rule = tpu_test_multihost_avg_rule()
+    assert mh["record"] == mh_rule.record
+    assert mh["expr"] == mh_rule.expr.promql()
+    assert mh["labels"] == mh_rule.labels
 
 
 def test_adapter_rules_cover_all_recorded_series():
@@ -107,12 +114,21 @@ def test_adapter_rules_cover_all_recorded_series():
     assert adapter["rules"]["default"] is False  # explicit rules only
     series = {r["name"]["as"] for r in adapter["rules"]["custom"]}
     rule_doc = load("tpu-test-prometheusrule.yaml")
-    recorded = {r["record"] for r in rule_doc["spec"]["groups"][0]["rules"]}
+    recorded = {
+        r["record"]
+        for g in rule_doc["spec"]["groups"]
+        for r in g["rules"]
+    }
     assert series == recorded
     for r in adapter["rules"]["custom"]:
         overrides = r["resources"]["overrides"]
         assert overrides["namespace"] == {"resource": "namespace"}
-        assert overrides["deployment"] == {"resource": "deployment"}
+        # each series is addressed at the object kind its output label names
+        target = "statefulset" if "statefulset" in r["seriesQuery"] else "deployment"
+        assert overrides[target] == {"resource": target}
+        # the output-label association trick requires the seriesQuery to
+        # demand the label exists
+        assert f'{target}!=""' in r["seriesQuery"]
 
 
 def test_hpa_contracts():
@@ -125,6 +141,109 @@ def test_hpa_contracts():
     assert metric["metric"]["name"] == "tpu_test_tensorcore_avg"
     assert metric["describedObject"]["name"] == "tpu-test"
     assert float(metric["target"]["value"]) == 40.0
+
+
+def test_multihost_workload_contracts():
+    svc, sts = load("tpu-test-multihost.yaml")
+    assert svc["kind"] == "Service"
+    assert svc["spec"]["clusterIP"] == "None"  # headless, for per-pod DNS
+    assert sts["kind"] == "StatefulSet"
+    assert sts["spec"]["serviceName"] == svc["metadata"]["name"]
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    tmpl = sts["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["app"] == "tpu-test-multihost"
+    assert svc["spec"]["selector"]["app"] == "tpu-test-multihost"
+    container = tmpl["spec"]["containers"][0]
+    assert container["command"][-1] == "k8s_gpu_hpa_tpu.loadgen.multihost"
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["HEADLESS_SERVICE"] == svc["metadata"]["name"]
+    hosts_per_slice = int(env["HOSTS_PER_SLICE"])
+    assert hosts_per_slice == 2  # v5p-16: 8 chips over 2 hosts
+    assert container["resources"]["limits"]["google.com/tpu"] == 4
+
+
+def test_multihost_hpa_slice_atomicity_contracts():
+    _, sts = load("tpu-test-multihost.yaml")
+    env = {
+        e["name"]: e.get("value")
+        for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    hosts_per_slice = int(env["HOSTS_PER_SLICE"])
+    hpa = load("tpu-test-multihost-hpa.yaml")
+    assert hpa["apiVersion"] == "autoscaling/v2"
+    quantum = quantum_from_manifest(hpa)
+    assert quantum == hosts_per_slice  # annotation must track the workload
+    spec = hpa["spec"]
+    assert spec["scaleTargetRef"] == {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "name": sts["metadata"]["name"],
+    }
+    # bounds and every Pods policy land on slice boundaries
+    assert spec["minReplicas"] % quantum == 0
+    assert spec["maxReplicas"] % quantum == 0
+    for direction in ("scaleUp", "scaleDown"):
+        for policy in spec["behavior"][direction]["policies"]:
+            if policy["type"] == "Pods":
+                assert policy["value"] % quantum == 0
+    metric = spec["metrics"][0]["object"]
+    assert metric["metric"]["name"] == "tpu_test_multihost_tensorcore_avg"
+    assert metric["describedObject"]["kind"] == "StatefulSet"
+
+
+def test_shipped_multihost_hpa_scales_by_slices_in_simulation():
+    """Parse the real multihost manifests into the sim: behavior, target,
+    bounds, and quantum all come from the YAML, and the loop must take the
+    StatefulSet 2->8 pods in whole-slice steps under load."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    _, sts = load("tpu-test-multihost.yaml")
+    env = {
+        e["name"]: e.get("value")
+        for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    hosts_per_slice = int(env["HOSTS_PER_SLICE"])
+    chips_per_pod = sts["spec"]["template"]["spec"]["containers"][0]["resources"][
+        "limits"
+    ]["google.com/tpu"]
+    hpa_doc = load("tpu-test-multihost-hpa.yaml")
+
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"v5p-node-{i}", chips_per_pod) for i in range(8)],
+        pod_start_latency=12.0,
+    )
+    deployment = SimDeployment(
+        cluster,
+        name=sts["metadata"]["name"],
+        app_label=sts["spec"]["template"]["metadata"]["labels"]["app"],
+        chips_per_pod=chips_per_pod,
+        hosts_per_slice=hosts_per_slice,
+        load_fn=lambda t: 320.0 if t >= 60.0 else 20.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(deployment, replicas=hpa_doc["spec"]["minReplicas"])
+    clock.advance(15.0)
+    pipeline = AutoscalingPipeline(
+        cluster,
+        deployment,
+        record=hpa_doc["spec"]["metrics"][0]["object"]["metric"]["name"],
+        target_value=float(
+            hpa_doc["spec"]["metrics"][0]["object"]["target"]["value"]
+        ),
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+        replica_quantum=quantum_from_manifest(hpa_doc),
+        object_kind="StatefulSet",
+    )
+    pipeline.run_for(180.0)
+    assert pipeline.replicas() == hpa_doc["spec"]["maxReplicas"]
+    for _, _, new in pipeline.scale_history:
+        assert new % hosts_per_slice == 0, pipeline.scale_history
 
 
 def test_shipped_hpa_clears_north_star_in_simulation():

@@ -1,0 +1,264 @@
+"""Multi-host slice support (BASELINE configs[4]): topology resolution,
+slice-atomic HPA scaling, and the closed loop over a StatefulSet of slices.
+
+The reference's replicas never span hosts (SURVEY.md §2c); this rung is the
+TPU-native axis SURVEY.md §7(c,d) flags: per-host exporters aggregated by the
+recording rule, and replicas that must move in whole-slice quanta."""
+
+import pytest
+
+from k8s_gpu_hpa_tpu.control.adapter import CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPAController,
+    ObjectMetricSpec,
+    quantum_from_manifest,
+)
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.loadgen.multihost import (
+    HostTopology,
+    pod_ordinal,
+    topology_from_env,
+)
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+# ---- topology resolution ----------------------------------------------------
+
+
+def test_explicit_env_topology():
+    topo = topology_from_env(
+        {
+            "COORDINATOR_ADDRESS": "coord:1234",
+            "NUM_PROCESSES": "4",
+            "PROCESS_ID": "2",
+        },
+        hostname="whatever",
+    )
+    assert topo == HostTopology(2, 4, "coord:1234")
+
+
+def test_gke_webhook_topology():
+    topo = topology_from_env(
+        {
+            "TPU_WORKER_HOSTNAMES": "host-a,host-b",
+            "TPU_WORKER_ID": "1",
+        },
+        hostname="host-b",
+    )
+    assert topo.num_processes == 2
+    assert topo.process_id == 1
+    assert topo.coordinator_address == "host-a:8476"
+
+
+@pytest.mark.parametrize(
+    "hostname,slice_index,worker,coordinator_pod",
+    [
+        ("tpu-test-multihost-0", 0, 0, "tpu-test-multihost-0"),
+        ("tpu-test-multihost-1", 0, 1, "tpu-test-multihost-0"),
+        ("tpu-test-multihost-4", 2, 0, "tpu-test-multihost-4"),
+        ("tpu-test-multihost-5", 2, 1, "tpu-test-multihost-4"),
+    ],
+)
+def test_statefulset_topology(hostname, slice_index, worker, coordinator_pod):
+    env = {"HOSTS_PER_SLICE": "2", "HEADLESS_SERVICE": "tpu-test-multihost"}
+    topo = topology_from_env(env, hostname=hostname)
+    assert topo.slice_index == slice_index
+    assert topo.worker_index == worker
+    assert topo.num_processes == 2
+    assert topo.coordinator_address == (
+        f"{coordinator_pod}.tpu-test-multihost.default.svc.cluster.local:8476"
+    )
+
+
+def test_no_env_means_single_process():
+    assert topology_from_env({}, hostname="h") is None
+    assert topology_from_env({"HOSTS_PER_SLICE": "1"}, hostname="x-3") is None
+
+
+def test_statefulset_topology_requires_ordinal():
+    with pytest.raises(ValueError):
+        topology_from_env({"HOSTS_PER_SLICE": "2"}, hostname="no-ordinal-here")
+
+
+def test_pod_ordinal():
+    assert pod_ordinal("a-b-12") == 12
+    assert pod_ordinal("a") is None
+    assert pod_ordinal("a-") is None
+
+
+# ---- slice-atomic HPA scaling ----------------------------------------------
+
+
+class FakeTarget:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def scale_to(self, n):
+        self.replicas = n
+
+
+class FakeAdapter(CustomMetricsAdapter):
+    def __init__(self, value):
+        self.value = value
+
+    def get_object_metric(self, ref, name):
+        return self.value
+
+
+def make_hpa(value, replicas=2, quantum=2, **kw):
+    target = FakeTarget(replicas)
+    hpa = HPAController(
+        target=target,
+        metrics=[
+            ObjectMetricSpec(
+                "m", 40.0, ObjectReference("StatefulSet", "tpu-test-multihost")
+            )
+        ],
+        adapter=FakeAdapter(value),
+        clock=VirtualClock(),
+        min_replicas=kw.pop("min_replicas", 2),
+        max_replicas=kw.pop("max_replicas", 8),
+        replica_quantum=quantum,
+        **kw,
+    )
+    return hpa, target
+
+
+def test_quantum_rounds_scale_up_to_whole_slices():
+    hpa, target = make_hpa(value=65.0)  # ceil(2 * 65/40) = 4... try odd: 70 -> 4
+    hpa.sync_once()
+    assert target.replicas == 4
+    hpa2, target2 = make_hpa(value=50.0)  # ceil(2*50/40)=3 -> rounds up to 4
+    hpa2.sync_once()
+    assert target2.replicas == 4
+
+
+def test_quantum_rounds_scale_down_to_whole_slices():
+    hpa, target = make_hpa(value=22.0, replicas=6)
+    # ceil(6*22/40)=4 (already a multiple); with value 25 -> ceil 4 too; use
+    # value giving odd desired: 6*30/40=4.5 -> ceil 5 -> floor to 4
+    hpa2, target2 = make_hpa(value=30.0, replicas=6)
+    hpa2.sync_once()
+    assert target2.replicas == 4
+    hpa.sync_once()
+    assert target.replicas == 4
+
+
+def test_quantum_respects_quantized_bounds():
+    # max 7 with quantum 2 must cap at 6, never strand a half slice
+    hpa, target = make_hpa(value=400.0, max_replicas=7)
+    hpa.sync_once()
+    assert target.replicas == 6
+    # min 3 with quantum 2 floors scale-down at 4
+    hpa2, target2 = make_hpa(value=1.0, replicas=6, min_replicas=3)
+    hpa2.sync_once()
+    assert target2.replicas == 4
+
+
+def test_quantum_repairs_partial_slice_within_tolerance():
+    """kubectl-scaled to 3 pods (a stranded half slice) with the metric within
+    tolerance: the controller must release the orphan host, not hold forever."""
+    hpa, target = make_hpa(value=40.0, replicas=3)  # ratio 1.0 -> hold
+    hpa.sync_once()
+    assert target.replicas == 2
+    assert "repair partial slice" in hpa.status.last_reason
+
+
+def test_quantum_larger_than_max_replicas_rejected():
+    with pytest.raises(ValueError):
+        make_hpa(value=50.0, quantum=4, max_replicas=3)
+
+
+def test_empty_worker_hostnames_falls_through():
+    assert topology_from_env({"TPU_WORKER_HOSTNAMES": ""}, hostname="h") is None
+    assert topology_from_env({"TPU_WORKER_HOSTNAMES": ",,"}, hostname="h") is None
+
+
+def test_hosts_per_slice_one_ignores_hostname_shape():
+    # single-host config must not demand a StatefulSet ordinal
+    env = {"HOSTS_PER_SLICE": "1"}
+    assert topology_from_env(env, hostname="tpu-test-7d9f4b-x2kqz") is None
+
+
+def test_quantum_one_is_vanilla():
+    hpa, target = make_hpa(value=50.0, quantum=1, replicas=2)
+    hpa.sync_once()
+    assert target.replicas == 3
+
+
+def test_quantum_from_manifest_annotation():
+    assert quantum_from_manifest({"metadata": {}}) == 1
+    assert (
+        quantum_from_manifest(
+            {"metadata": {"annotations": {"k8s-tpu-hpa/replica-quantum": "2"}}}
+        )
+        == 2
+    )
+
+
+# ---- slice semantics in the sim cluster -------------------------------------
+
+
+def test_incomplete_slice_hosts_sit_at_barrier():
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("n0", 16)], pod_start_latency=1.0)
+    dep = SimDeployment(
+        cluster,
+        "tpu-test-multihost",
+        "tpu-test-multihost",
+        chips_per_pod=4,
+        hosts_per_slice=2,
+        load_fn=lambda t: 80.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(dep, replicas=3)  # one complete slice + one orphan host
+    clock.advance(5.0)
+    pods = sorted(
+        cluster.running_pods(dep.name), key=lambda p: (p.created_at, p.name)
+    )
+    assert len(pods) == 3
+    utils = [dep.pod_utilization(p) for p in pods]
+    assert utils[0] == utils[1] == 80.0  # the complete slice carries the load
+    assert utils[2] == dep.barrier_idle_util  # the orphan blocks at init
+
+
+def test_multihost_closed_loop_scales_by_whole_slices():
+    """The configs[4] scenario end-to-end in sim: per-host exporters on two
+    nodes, the statefulset-addressed recording rule, and slice-quantum HPA
+    scaling 2->8 pods (1->4 slices) under load."""
+    clock = VirtualClock()
+    # 8 v5p hosts of 4 chips each: one pod per host, 4 slices of 2 hosts
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"v5p-node-{i}", 4) for i in range(8)],
+        pod_start_latency=12.0,
+    )
+    dep = SimDeployment(
+        cluster,
+        "tpu-test-multihost",
+        "tpu-test-multihost",
+        chips_per_pod=4,
+        hosts_per_slice=2,
+        load_fn=lambda t: 320.0 if t >= 60.0 else 20.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(dep, replicas=2)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(
+        cluster,
+        dep,
+        record="tpu_test_multihost_tensorcore_avg",
+        target_value=40.0,
+        min_replicas=2,
+        max_replicas=8,
+        replica_quantum=2,
+        object_kind="StatefulSet",
+    )
+    pipe.run_for(180.0)
+    assert pipe.replicas() == 8
+    # every scale event lands on a slice boundary
+    for _, old, new in pipe.scale_history:
+        assert new % 2 == 0, pipe.scale_history
+    # and the pods actually fit 4 slices x 2 hosts x 4 chips = 2 full nodes
+    assert pipe.running() == 8

@@ -15,7 +15,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from k8s_gpu_hpa_tpu.metrics.rules import tpu_test_avg_rule
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    tpu_test_avg_rule,
+    tpu_test_multihost_avg_rule,
+)
 from k8s_gpu_hpa_tpu.metrics.schema import (
     TPU_DUTY_CYCLE,
     TPU_HBM_BW_UTIL,
@@ -56,17 +59,30 @@ RULES = [
 ]
 
 
+def _render_rule(rule, comment=None) -> str:
+    out = []
+    if comment:
+        out.append(f"        {comment}\n")
+    out.append(f"        - record: {rule.record}\n")
+    out.append(f"          expr: {rule.expr.promql()}\n")
+    out.append("          labels:\n")
+    for k, v in rule.labels.items():
+        out.append(f"            {k}: {v}\n")
+    return "".join(out)
+
+
 def render() -> str:
     out = [HEADER]
     for record, metric, comment in RULES:
-        rule = tpu_test_avg_rule(metric=metric, record=record)
-        if comment:
-            out.append(f"        {comment}\n")
-        out.append(f"        - record: {rule.record}\n")
-        out.append(f"          expr: {rule.expr.promql()}\n")
-        out.append("          labels:\n")
-        for k, v in rule.labels.items():
-            out.append(f"            {k}: {v}\n")
+        out.append(_render_rule(tpu_test_avg_rule(metric=metric, record=record), comment))
+    out.append(
+        "    # multi-host rung (BASELINE configs[4]): per-host pods of the\n"
+        "    # StatefulSet-of-slices, addressed at the StatefulSet object\n"
+        "    - name: tpu-test-multihost\n"
+        "      interval: 1s\n"
+        "      rules:\n"
+    )
+    out.append(_render_rule(tpu_test_multihost_avg_rule()))
     return "".join(out)
 
 
