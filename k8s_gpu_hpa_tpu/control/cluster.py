@@ -114,6 +114,23 @@ class SimDeployment:
         return min(100.0, offered / len(running))
 
 
+@dataclass
+class SimResourceMetrics:
+    """metrics.k8s.io stand-in (the metrics-server path vanilla HPAs use,
+    BASELINE configs[0]): per-pod utilization percent for one deployment's
+    running pods, driven by the same offered-load model as the chip metrics."""
+
+    cluster: "SimCluster"
+    deployment: str
+
+    def pod_utilizations(self, resource: str) -> list[float]:
+        dep = self.cluster.deployments[self.deployment]
+        return [
+            dep.pod_utilization(p)
+            for p in self.cluster.running_pods(self.deployment)
+        ]
+
+
 class _NodeExporter:
     """The per-node metrics endpoint, with a collection-interval cache: readings
     refresh at most every ``sample_interval`` seconds, like dcgm-exporter's

@@ -39,6 +39,24 @@ class ObjectMetricSpec:
 
 
 @dataclass
+class ResourceMetricSpec:
+    """One Resource-type metric: ``resource`` (e.g. "cpu") with a target
+    average utilization percent of the pods' requests — the metrics.k8s.io
+    path vanilla HPAs use (BASELINE configs[0], the no-accelerator sanity
+    rung; deploy/cpu-busyloop-hpa.yaml)."""
+
+    resource: str
+    target_average_utilization: float
+
+
+class ResourceMetricsReader(Protocol):
+    """metrics.k8s.io stand-in: per-pod utilization percent of request for the
+    scale target's pods."""
+
+    def pod_utilizations(self, resource: str) -> list[float]: ...
+
+
+@dataclass
 class ScalingPolicy:
     """``type: Pods|Percent, value, periodSeconds`` — max change per period."""
 
@@ -138,8 +156,8 @@ class HPAController:
     def __init__(
         self,
         target: ScalableTarget,
-        metrics: list[ObjectMetricSpec],
-        adapter: CustomMetricsAdapter,
+        metrics: list[ObjectMetricSpec | ResourceMetricSpec],
+        adapter: CustomMetricsAdapter | None,
         clock: Clock,
         min_replicas: int = 1,
         max_replicas: int = 4,
@@ -147,6 +165,7 @@ class HPAController:
         sync_interval: float = 15.0,
         on_scale: Callable[[int, int], None] | None = None,
         replica_quantum: int = 1,
+        resource_metrics: ResourceMetricsReader | None = None,
     ):
         self.target = target
         self.metrics = metrics
@@ -171,6 +190,7 @@ class HPAController:
                 f"replica_quantum={replica_quantum} pods"
             )
         self.replica_quantum = replica_quantum
+        self.resource_metrics = resource_metrics
         self.status = HPAStatus(current_replicas=target.replicas)
         #: (ts, recommendation) ring for stabilization windows
         self._recommendations: list[tuple[float, int]] = []
@@ -179,12 +199,29 @@ class HPAController:
 
     # ---- core v2 algorithm -------------------------------------------------
 
-    def _metric_proposal(self, spec: ObjectMetricSpec, current: int) -> int | None:
-        value = self.adapter.get_object_metric(spec.described_object, spec.metric_name)
-        if value is None:
-            return None
-        self.status.last_metric_values[spec.metric_name] = value
-        ratio = value / spec.target_value
+    def _metric_proposal(
+        self, spec: ObjectMetricSpec | ResourceMetricSpec, current: int
+    ) -> int | None:
+        if isinstance(spec, ResourceMetricSpec):
+            if self.resource_metrics is None:
+                return None
+            utils = self.resource_metrics.pod_utilizations(spec.resource)
+            if not utils:
+                return None
+            value = sum(utils) / len(utils)
+            self.status.last_metric_values[f"resource/{spec.resource}"] = value
+            target = spec.target_average_utilization
+        else:
+            if self.adapter is None:
+                return None
+            value = self.adapter.get_object_metric(
+                spec.described_object, spec.metric_name
+            )
+            if value is None:
+                return None
+            self.status.last_metric_values[spec.metric_name] = value
+            target = spec.target_value
+        ratio = value / target
         if abs(ratio - 1.0) <= self.TOLERANCE:
             return current
         return max(1, math.ceil(current * ratio))
@@ -276,8 +313,10 @@ class HPAController:
         if q > 1:
             # Round up when growing (a partial slice serves nothing, so the
             # policy step may be exceeded by < one quantum; rounding down
-            # instead could deadlock against a tight policy forever), down
-            # when shrinking (never tear half a slice).  Bounds that aren't
+            # instead could deadlock against a tight policy forever).  When
+            # shrinking, round up TOWARD current: behavior policies are hard
+            # caps in the down direction, so hold the extra slice until the
+            # policy window permits removing a whole one.  Bounds that aren't
             # slice multiples would themselves strand a partial slice; snap
             # them inward (the constructor guarantees max_replicas >= q).
             max_q = self.max_replicas // q * q
@@ -285,7 +324,7 @@ class HPAController:
             if desired > current:
                 desired = min(math.ceil(desired / q) * q, max_q)
             elif desired < current:
-                desired = max(desired // q * q, min_q)
+                desired = max(min(math.ceil(desired / q) * q, current), min_q)
             elif desired % q:
                 # current count is itself a partial slice (operator kubectl-
                 # scaled, or the HPA adopted a misaligned target): repair by

@@ -80,7 +80,6 @@ class AutoscalingPipeline:
                 namespace=deployment.namespace,
                 record=record,
             )
-            overrides = {"namespace": "namespace", "statefulset": "StatefulSet"}
         else:
             primary = tpu_test_avg_rule(
                 app=deployment.app_label,
@@ -88,13 +87,22 @@ class AutoscalingPipeline:
                 namespace=deployment.namespace,
                 record=record,
             )
-            overrides = {"namespace": "namespace", "deployment": "Deployment"}
         rules = [primary] + (extra_rules or [])
         self.evaluator = RuleEvaluator(self.db, rules, interval=self.intervals.rule_eval)
 
+        def overrides_for(rule: RecordingRule) -> dict[str, str]:
+            # each rule's series is addressed at whatever object kind its own
+            # output labels name (mixing deployment- and statefulset-scoped
+            # rules in one pipeline must keep both resolvable)
+            kind = "StatefulSet" if "statefulset" in rule.labels else "Deployment"
+            return {"namespace": "namespace", kind.lower(): kind}
+
         self.adapter = CustomMetricsAdapter(
             self.db,
-            [AdapterRule(series=r.record, resource_overrides=overrides) for r in rules],
+            [
+                AdapterRule(series=r.record, resource_overrides=overrides_for(r))
+                for r in rules
+            ],
         )
 
         ref = ObjectReference(object_kind, deployment.name, deployment.namespace)

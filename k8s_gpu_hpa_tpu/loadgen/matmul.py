@@ -25,11 +25,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from k8s_gpu_hpa_tpu.loadgen.knob import (  # noqa: F401  (re-exported names)
+    DEFAULT_INTENSITY_FILE,
+    INTENSITY_ENV,
+    INTENSITY_FILE_ENV,
+    IntensityKnob,
+)
 from k8s_gpu_hpa_tpu.ops.pallas_matmul import HAVE_PALLAS, matmul_pallas
-
-INTENSITY_ENV = "TPU_TEST_INTENSITY"
-INTENSITY_FILE_ENV = "TPU_TEST_INTENSITY_FILE"
-DEFAULT_INTENSITY_FILE = "/tmp/tpu-test-intensity"
 
 #: bf16 peak TFLOP/s per chip by device kind (public Cloud TPU specs).
 PEAK_BF16_TFLOPS = {
@@ -81,14 +83,7 @@ class MatmulLoadGen:
         self.iters_per_burst = iters_per_burst
         self.device = device or jax.devices()[0]
         self.window = window
-        self._intensity = (
-            intensity
-            if intensity is not None
-            else float(os.environ.get(INTENSITY_ENV, "1.0"))
-        )
-        self.intensity_file = os.environ.get(
-            INTENSITY_FILE_ENV, DEFAULT_INTENSITY_FILE
-        )
+        self.knob = IntensityKnob(intensity)
         self.peak_tflops = peak_tflops_for(self.device)
         key = jax.random.PRNGKey(0)
         with jax.default_device(self.device):
@@ -120,24 +115,28 @@ class MatmulLoadGen:
         self._history: list[tuple[float, float, float]] = []  # (t, busy, flops)
         self._steps = 0
 
-    # ---- intensity knob ----------------------------------------------------
+    # ---- intensity knob (shared semantics: loadgen/knob.py) ----------------
 
     @property
     def intensity(self) -> float:
-        return self._intensity
+        return self.knob.value
 
     def set_intensity(self, value: float) -> None:
-        self._intensity = max(0.0, min(1.0, value))
+        self.knob.set(value)
+
+    @property
+    def intensity_file(self) -> str:
+        return self.knob.file
+
+    @intensity_file.setter
+    def intensity_file(self, path: str) -> None:
+        self.knob.file = path
 
     def poll_intensity_file(self) -> None:
         """The kubectl-exec knob: read a float duty cycle from the watched file
         (analog of rerunning the vectorAdd loop inside the pod,
         README.md:113-116)."""
-        try:
-            with open(self.intensity_file) as f:
-                self.set_intensity(float(f.read().strip()))
-        except (OSError, ValueError):
-            pass  # file absent or mid-write: keep current intensity
+        self.knob.poll()
 
     # ---- run loop ----------------------------------------------------------
 
@@ -157,10 +156,8 @@ class MatmulLoadGen:
 
     def step(self) -> float:
         """One burst + duty-cycle sleep; returns busy seconds."""
-        self.poll_intensity_file()
-        intensity = self._intensity  # snapshot: may be set from another thread
-        if intensity <= 0.0:
-            time.sleep(0.05)
+        if self.knob.poll() <= 0.0:
+            self.knob.throttle(0.0)  # idle-poll, don't spin
             self._record(0.0, 0.0)
             return 0.0
         t0 = time.perf_counter()
@@ -169,9 +166,7 @@ class MatmulLoadGen:
         flops = 2.0 * self.size**3 * self.iters_per_burst
         self._record(busy, flops)
         self._steps += 1
-        # duty cycle: busy/(busy+idle) = intensity
-        if intensity < 1.0:
-            time.sleep(busy * (1.0 - intensity) / intensity)
+        self.knob.throttle(busy)  # duty cycle: busy/(busy+idle) = intensity
         return busy
 
     def run_for(self, seconds: float) -> LoadGenStats:

@@ -138,11 +138,7 @@ def main() -> None:
     import jax
 
     from k8s_gpu_hpa_tpu.loadgen.allreduce import AllReduceLoadGen
-    from k8s_gpu_hpa_tpu.loadgen.matmul import (
-        DEFAULT_INTENSITY_FILE,
-        INTENSITY_ENV,
-        INTENSITY_FILE_ENV,
-    )
+    from k8s_gpu_hpa_tpu.loadgen.knob import IntensityKnob
     from k8s_gpu_hpa_tpu.parallel.mesh import make_mesh
 
     topology = initialize()
@@ -151,29 +147,21 @@ def main() -> None:
         mesh=mesh, buffer_mb=float(os.environ.get("BUFFER_MB", "64"))
     )
     gen.warmup()
-    intensity_file = os.environ.get(INTENSITY_FILE_ENV, DEFAULT_INTENSITY_FILE)
-    intensity = float(os.environ.get(INTENSITY_ENV, "1.0"))
+    knob = IntensityKnob()
     report_every = float(os.environ.get("REPORT_S", "10"))
     print(
         f"tpu-test multihost loadgen: process {jax.process_index()}/"
         f"{jax.process_count()} slice="
         f"{topology.slice_index if topology else 0} mesh={dict(mesh.shape)} "
-        f"(knob: {intensity_file})",
+        f"(knob: {knob.file})",
         flush=True,
     )
     last_report = time.perf_counter()
     while True:
-        try:
-            with open(intensity_file) as f:
-                intensity = max(0.0, min(1.0, float(f.read().strip())))
-        except (OSError, ValueError):
-            pass  # file absent or mid-write: keep current intensity
-        if intensity <= 0.0:
-            time.sleep(0.05)
+        if knob.poll() <= 0.0:
+            knob.throttle(0.0)
         else:
-            busy = gen.step()
-            if intensity < 1.0:
-                time.sleep(busy * (1.0 - intensity) / intensity)
+            knob.throttle(gen.step())
         if time.perf_counter() - last_report >= report_every:
             s = gen.stats()
             print(

@@ -135,14 +135,28 @@ def test_quantum_rounds_scale_up_to_whole_slices():
 
 
 def test_quantum_rounds_scale_down_to_whole_slices():
+    # ceil(6*22/40)=4, already a slice multiple: tear exactly one slice
     hpa, target = make_hpa(value=22.0, replicas=6)
-    # ceil(6*22/40)=4 (already a multiple); with value 25 -> ceil 4 too; use
-    # value giving odd desired: 6*30/40=4.5 -> ceil 5 -> floor to 4
-    hpa2, target2 = make_hpa(value=30.0, replicas=6)
-    hpa2.sync_once()
-    assert target2.replicas == 4
     hpa.sync_once()
     assert target.replicas == 4
+    # odd desired (ceil(6*30/40)=5) rounds UP toward current: hold the extra
+    # slice rather than exceed what the metric (and any policy cap) justifies
+    hpa2, target2 = make_hpa(value=30.0, replicas=6)
+    hpa2.sync_once()
+    assert target2.replicas == 6
+
+
+def test_quantum_scale_down_never_violates_policy_cap():
+    """A Pods=1/60s scale-down policy is a hard cap; with quantum 2 the
+    controller must hold rather than floor past the cap."""
+    from k8s_gpu_hpa_tpu.control.hpa import HPABehavior, ScalingPolicy, ScalingRules
+
+    behavior = HPABehavior(
+        scale_down=ScalingRules(policies=[ScalingPolicy("Pods", 1, 60.0)])
+    )
+    hpa, target = make_hpa(value=5.0, replicas=6, behavior=behavior)
+    hpa.sync_once()
+    assert target.replicas == 6  # policy allows 5, quantum holds at 6
 
 
 def test_quantum_respects_quantized_bounds():

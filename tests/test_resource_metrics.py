@@ -1,0 +1,164 @@
+"""Resource-metric (cpu) HPA support — BASELINE configs[0], the
+no-accelerator sanity rung: vanilla metrics.k8s.io semantics through the same
+controller algorithm as the TPU Object metrics."""
+
+import yaml
+from pathlib import Path
+
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment, SimResourceMetrics
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPAController,
+    ObjectMetricSpec,
+    ResourceMetricSpec,
+    behavior_from_manifest,
+)
+from k8s_gpu_hpa_tpu.control.adapter import ObjectReference
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+DEPLOY = Path(__file__).parent.parent / "deploy"
+
+
+class FakeTarget:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+
+    def scale_to(self, n):
+        self.replicas = n
+
+
+class FakeReader:
+    def __init__(self, utils):
+        self.utils = utils
+
+    def pod_utilizations(self, resource):
+        assert resource == "cpu"
+        return self.utils
+
+
+def make_hpa(utils, replicas=1, target=60.0, **kw):
+    t = FakeTarget(replicas)
+    hpa = HPAController(
+        target=t,
+        metrics=[ResourceMetricSpec("cpu", target)],
+        adapter=None,
+        clock=VirtualClock(),
+        resource_metrics=FakeReader(utils),
+        **kw,
+    )
+    return hpa, t
+
+
+def test_scale_up_on_average_utilization():
+    # avg 90% vs target 60% -> ceil(2 * 1.5) = 3
+    hpa, target = make_hpa([80.0, 100.0], replicas=2)
+    hpa.sync_once()
+    assert target.replicas == 3
+    assert hpa.status.last_metric_values["resource/cpu"] == 90.0
+
+
+def test_within_tolerance_holds():
+    hpa, target = make_hpa([63.0], replicas=2)  # ratio 1.05 < 1.1
+    hpa.sync_once()
+    assert target.replicas == 2
+
+
+def test_no_pod_metrics_holds():
+    hpa, target = make_hpa([], replicas=3)
+    hpa.sync_once()
+    assert target.replicas == 3
+    assert "metrics unavailable" in hpa.status.last_reason
+
+
+def test_no_reader_holds():
+    t = FakeTarget(2)
+    hpa = HPAController(
+        target=t,
+        metrics=[ResourceMetricSpec("cpu", 60.0)],
+        adapter=None,
+        clock=VirtualClock(),
+    )
+    hpa.sync_once()
+    assert t.replicas == 2
+
+
+def test_mixed_resource_and_object_metrics_take_max():
+    """autoscaling/v2 semantics: largest proposal across all metrics wins."""
+
+    class OneValueAdapter:
+        def get_object_metric(self, ref, name):
+            return 90.0  # vs target 40 -> ceil(1*2.25) = 3
+
+    t = FakeTarget(1)
+    hpa = HPAController(
+        target=t,
+        metrics=[
+            ResourceMetricSpec("cpu", 60.0),  # 30% -> proposes 1
+            ObjectMetricSpec("m", 40.0, ObjectReference("Deployment", "d")),
+        ],
+        adapter=OneValueAdapter(),
+        clock=VirtualClock(),
+        resource_metrics=FakeReader([30.0]),
+    )
+    hpa.sync_once()
+    assert t.replicas == 3
+
+
+def test_cpu_busyloop_manifest_contracts():
+    dep = yaml.safe_load((DEPLOY / "cpu-busyloop.yaml").read_text())
+    hpa = yaml.safe_load((DEPLOY / "cpu-busyloop-hpa.yaml").read_text())
+    assert "google.com/tpu" not in str(dep)  # the whole point of this rung
+    assert dep["spec"]["template"]["spec"]["containers"][0]["resources"][
+        "requests"
+    ]["cpu"] == "500m"
+    assert hpa["spec"]["scaleTargetRef"]["name"] == dep["metadata"]["name"]
+    metric = hpa["spec"]["metrics"][0]
+    assert metric["type"] == "Resource"
+    assert metric["resource"]["name"] == "cpu"
+    assert metric["resource"]["target"]["averageUtilization"] == 60
+
+
+def test_cpu_rung_closed_loop_in_simulation():
+    """The configs[0] scenario: busyloop pods, metrics-server stand-in, the
+    shipped HPA's behavior — scale 1->4 under load and hold."""
+    hpa_doc = yaml.safe_load((DEPLOY / "cpu-busyloop-hpa.yaml").read_text())
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("node-0", 0)], pod_start_latency=3.0)
+
+    # CPU pods claim no chips; give them per-pod load like the busyloop
+    dep = SimDeployment(
+        cluster,
+        "cpu-busyloop",
+        "cpu-busyloop",
+        chips_per_pod=0,
+        load_fn=lambda t: 300.0 if t >= 30.0 else 20.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(5.0)
+    target_util = hpa_doc["spec"]["metrics"][0]["resource"]["target"][
+        "averageUtilization"
+    ]
+    hpa = HPAController(
+        target=dep,
+        metrics=[ResourceMetricSpec("cpu", float(target_util))],
+        adapter=None,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+        resource_metrics=SimResourceMetrics(cluster, "cpu-busyloop"),
+    )
+
+    def sync_every_15s(until):
+        while clock.now() < until:
+            clock.advance(15.0)
+            hpa.sync_once()
+
+    sync_every_15s(20.0)  # syncs at t=15 only: pre-spike
+    assert dep.replicas == 1
+    sync_every_15s(120.0)
+    assert dep.replicas == 4
+    # load spread over 4 pods: 75% avg vs 60 target -> ratio 1.25, scale
+    # capped at max; stays there
+    sync_every_15s(240.0)
+    assert dep.replicas == 4
