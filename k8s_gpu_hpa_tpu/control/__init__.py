@@ -1,10 +1,19 @@
-from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.adapter import (
+    AdapterRule,
+    CustomMetricsAdapter,
+    ExternalRule,
+    ObjectReference,
+)
 from k8s_gpu_hpa_tpu.control.hpa import (
     behavior_from_manifest,
+    ExternalMetricSpec,
     HPABehavior,
     HPAController,
     HPAStatus,
+    metrics_from_manifest,
     ObjectMetricSpec,
+    PodsMetricSpec,
+    ResourceMetricSpec,
     ScalingPolicy,
     ScalingRules,
 )
@@ -13,12 +22,17 @@ from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment, SimNode, 
 __all__ = [
     "AdapterRule",
     "CustomMetricsAdapter",
+    "ExternalRule",
     "ObjectReference",
+    "ExternalMetricSpec",
     "HPABehavior",
     "behavior_from_manifest",
+    "metrics_from_manifest",
     "HPAController",
     "HPAStatus",
     "ObjectMetricSpec",
+    "PodsMetricSpec",
+    "ResourceMetricSpec",
     "ScalingPolicy",
     "ScalingRules",
     "SimCluster",

@@ -50,12 +50,38 @@ class AdapterRule:
         return self.as_name or self.series
 
 
-class CustomMetricsAdapter:
-    """Serves instant metric values addressed by (object, metric-name)."""
+@dataclass
+class ExternalRule:
+    """One ``externalRules`` entry: a series served on
+    ``external.metrics.k8s.io`` — not associated with any Kubernetes object,
+    addressed by name + label selector within a namespace (prometheus-adapter
+    keeps the ``namespace`` label as the tenancy boundary)."""
 
-    def __init__(self, db: TimeSeriesDB, rules: list[AdapterRule]):
+    series: str
+    as_name: str = ""
+
+    @property
+    def metric_name(self) -> str:
+        return self.as_name or self.series
+
+
+class CustomMetricsAdapter:
+    """Serves instant metric values addressed by (object, metric-name).
+
+    One adapter instance models both aggregated APIs prometheus-adapter
+    registers: ``custom.metrics.k8s.io`` (``rules:`` → Object/Pods metrics)
+    and ``external.metrics.k8s.io`` (``externalRules:`` → External metrics).
+    """
+
+    def __init__(
+        self,
+        db: TimeSeriesDB,
+        rules: list[AdapterRule],
+        external_rules: list[ExternalRule] | None = None,
+    ):
         self.db = db
         self.rules = {r.metric_name: r for r in rules}
+        self.external_rules = {r.metric_name: r for r in (external_rules or [])}
 
     def list_metrics(self) -> list[str]:
         """API discovery: the set of metric names the adapter exposes — what the
@@ -65,6 +91,14 @@ class CustomMetricsAdapter:
             if self.db.instant_vector(rule.series):
                 available.append(name)
         return sorted(available)
+
+    def list_external_metrics(self) -> list[str]:
+        """Discovery on ``external.metrics.k8s.io`` (same raw-API probe shape)."""
+        return sorted(
+            name
+            for name, rule in self.external_rules.items()
+            if self.db.instant_vector(rule.series)
+        )
 
     def get_object_metric(self, ref: ObjectReference, metric_name: str) -> float | None:
         """Value of ``metric_name`` for the given object, or None if absent/stale.
@@ -91,3 +125,56 @@ class CustomMetricsAdapter:
                 f"adapter rule for {metric_name} matched {len(vec)} series for {ref}"
             )
         return vec[0].value
+
+    def get_pods_metric(
+        self, namespace: str, metric_name: str, pod_names: list[str]
+    ) -> dict[str, float]:
+        """Per-pod values for a Pods-type HPA metric.
+
+        The custom-metrics API path is
+        ``/namespaces/{ns}/pods/*/{metric}?labelSelector=...``; the HPA resolves
+        the selector to pod names and the adapter answers per pod.  The rule's
+        ``resource_overrides`` must map a label to ``Pod`` (prometheus-adapter
+        associates series to pods via their ``pod`` label).  Pods with no fresh
+        series are absent from the result — the HPA's missing-metric handling
+        decides what that means.
+        """
+        rule = self.rules.get(metric_name)
+        if rule is None:
+            return {}
+        pod_label = None
+        for label, kind in rule.resource_overrides.items():
+            if kind.lower() == "pod":
+                pod_label = label
+                break
+        if pod_label is None:
+            return {}
+        out: dict[str, float] = {}
+        for name in pod_names:
+            vec = self.db.instant_vector(
+                rule.series, {"namespace": namespace, pod_label: name}
+            )
+            if not vec:
+                continue
+            if len(vec) > 1:
+                raise ValueError(
+                    f"pods rule for {metric_name} matched {len(vec)} series "
+                    f"for pod {namespace}/{name}"
+                )
+            out[name] = vec[0].value
+        return out
+
+    def get_external_metric(
+        self,
+        namespace: str,
+        metric_name: str,
+        selector: dict[str, str] | None = None,
+    ) -> list[float]:
+        """All values of an External metric matching the label selector —
+        ``external.metrics.k8s.io`` returns a list; the HPA sums it."""
+        rule = self.external_rules.get(metric_name)
+        if rule is None:
+            return []
+        matchers = {"namespace": namespace}
+        matchers.update(selector or {})
+        return [s.value for s in self.db.instant_vector(rule.series, matchers)]

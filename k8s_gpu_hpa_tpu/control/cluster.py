@@ -94,6 +94,11 @@ class SimDeployment:
         self.replicas = replicas
         self.cluster.reconcile(self)
 
+    def ready_pod_names(self) -> list[str]:
+        """PodLister contract (control/hpa.py): the ready pods a Pods-type
+        metric averages over."""
+        return [p.name for p in self.cluster.running_pods(self.name)]
+
     def pod_utilization(self, pod: SimPod) -> float:
         """Current tensorcore utilization percent for one running pod."""
         offered = self.load_fn(self.cluster.clock.now())

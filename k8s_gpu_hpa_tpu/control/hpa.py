@@ -31,11 +31,16 @@ from k8s_gpu_hpa_tpu.utils.clock import Clock
 
 @dataclass
 class ObjectMetricSpec:
-    """One Object-type metric: name + target value (cuda-test-hpa.yaml:13-21)."""
+    """One Object-type metric: name + target (cuda-test-hpa.yaml:13-21).
+
+    ``target_value`` compares the object's metric directly; set
+    ``average`` for a ``target.type: AverageValue`` manifest, which divides
+    the object's value by current replicas before comparing."""
 
     metric_name: str
     target_value: float
     described_object: ObjectReference
+    average: bool = False
 
 
 @dataclass
@@ -47,6 +52,49 @@ class ResourceMetricSpec:
 
     resource: str
     target_average_utilization: float
+
+
+@dataclass
+class PodsMetricSpec:
+    """One Pods-type metric: a custom per-pod metric with an AverageValue
+    target.  The HPA averages the metric over the target's pods and scales by
+    value/target — the natural shape for per-chip HBM usage (BASELINE
+    configs[2]; deploy/tpu-test-hbm-hpa.yaml), where each pod owns a fixed
+    chip allotment and the signal is per-pod, not per-object."""
+
+    metric_name: str
+    target_average_value: float
+
+
+@dataclass
+class ExternalMetricSpec:
+    """One External-type metric: a series on ``external.metrics.k8s.io``
+    addressed by name + label selector, unassociated with any Kubernetes
+    object.  ``target_value`` compares the sum of matched series;
+    ``target_average_value`` divides that sum by current replicas (the
+    queue-depth-per-worker idiom)."""
+
+    metric_name: str
+    selector: dict[str, str] = field(default_factory=dict)
+    target_value: float | None = None
+    target_average_value: float | None = None
+    namespace: str = "default"
+
+    def __post_init__(self) -> None:
+        if (self.target_value is None) == (self.target_average_value is None):
+            raise ValueError(
+                "exactly one of target_value / target_average_value required"
+            )
+
+
+MetricSpec = ObjectMetricSpec | ResourceMetricSpec | PodsMetricSpec | ExternalMetricSpec
+
+
+class PodLister(Protocol):
+    """The pod-resolution contract Pods-type metrics need: the HPA lists the
+    scale target's ready pods, then asks the adapter for each pod's value."""
+
+    def ready_pod_names(self) -> list[str]: ...
 
 
 class ResourceMetricsReader(Protocol):
@@ -140,6 +188,86 @@ def behavior_from_manifest(hpa_doc: dict) -> HPABehavior:
     return behavior
 
 
+def metrics_from_manifest(hpa_doc: dict, namespace: str = "default") -> list[MetricSpec]:
+    """Parse the ``spec.metrics`` list of an autoscaling/v2 HPA manifest into
+    controller specs — all four metric types (Object / Pods / Resource /
+    External), with targets parsed as Kubernetes quantities (``"40"``,
+    ``"13Gi"``, ``"500m"``).  With ``behavior_from_manifest`` this makes the
+    shipped manifests the single source of truth the simulator executes."""
+    from k8s_gpu_hpa_tpu.utils.quantity import parse_quantity
+
+    specs: list[MetricSpec] = []
+    for m in hpa_doc["spec"].get("metrics", []):
+        kind = m["type"]
+        if kind == "Object":
+            o = m["object"]
+            target = o["target"]
+            average = "averageValue" in target
+            specs.append(
+                ObjectMetricSpec(
+                    metric_name=o["metric"]["name"],
+                    target_value=parse_quantity(
+                        target["averageValue"] if average else target["value"]
+                    ),
+                    described_object=ObjectReference(
+                        o["describedObject"]["kind"],
+                        o["describedObject"]["name"],
+                        o["describedObject"].get("namespace", namespace),
+                    ),
+                    average=average,
+                )
+            )
+        elif kind == "Pods":
+            p = m["pods"]
+            specs.append(
+                PodsMetricSpec(
+                    metric_name=p["metric"]["name"],
+                    target_average_value=parse_quantity(p["target"]["averageValue"]),
+                )
+            )
+        elif kind == "Resource":
+            r = m["resource"]
+            if "averageUtilization" not in r["target"]:
+                # our metrics.k8s.io reader supplies percent-of-request, not
+                # raw usage; reject the AverageValue shape explicitly rather
+                # than KeyError-ing or mis-scaling
+                raise ValueError(
+                    f"Resource metric {r['name']}: only target.type "
+                    "Utilization is supported (got "
+                    f"{r['target'].get('type', '?')})"
+                )
+            specs.append(
+                ResourceMetricSpec(
+                    resource=r["name"],
+                    target_average_utilization=float(
+                        r["target"]["averageUtilization"]
+                    ),
+                )
+            )
+        elif kind == "External":
+            e = m["external"]
+            target = e["target"]
+            selector = e["metric"].get("selector", {}).get("matchLabels", {})
+            specs.append(
+                ExternalMetricSpec(
+                    metric_name=e["metric"]["name"],
+                    selector=selector,
+                    target_value=(
+                        parse_quantity(target["value"]) if "value" in target else None
+                    ),
+                    target_average_value=(
+                        parse_quantity(target["averageValue"])
+                        if "averageValue" in target
+                        else None
+                    ),
+                    namespace=namespace,
+                )
+            )
+        else:
+            raise ValueError(f"unsupported HPA metric type {kind}")
+    return specs
+
+
 def quantum_from_manifest(hpa_doc: dict) -> int:
     """Slice-atomicity quantum from the ``k8s-tpu-hpa/replica-quantum``
     annotation (deploy/tpu-test-multihost-hpa.yaml); 1 when absent."""
@@ -156,7 +284,7 @@ class HPAController:
     def __init__(
         self,
         target: ScalableTarget,
-        metrics: list[ObjectMetricSpec | ResourceMetricSpec],
+        metrics: list[MetricSpec],
         adapter: CustomMetricsAdapter | None,
         clock: Clock,
         min_replicas: int = 1,
@@ -166,6 +294,8 @@ class HPAController:
         on_scale: Callable[[int, int], None] | None = None,
         replica_quantum: int = 1,
         resource_metrics: ResourceMetricsReader | None = None,
+        pod_lister: PodLister | None = None,
+        namespace: str = "default",
     ):
         self.target = target
         self.metrics = metrics
@@ -191,6 +321,8 @@ class HPAController:
             )
         self.replica_quantum = replica_quantum
         self.resource_metrics = resource_metrics
+        self.pod_lister = pod_lister
+        self.namespace = namespace
         self.status = HPAStatus(current_replicas=target.replicas)
         #: (ts, recommendation) ring for stabilization windows
         self._recommendations: list[tuple[float, int]] = []
@@ -199,9 +331,7 @@ class HPAController:
 
     # ---- core v2 algorithm -------------------------------------------------
 
-    def _metric_proposal(
-        self, spec: ObjectMetricSpec | ResourceMetricSpec, current: int
-    ) -> int | None:
+    def _metric_proposal(self, spec: MetricSpec, current: int) -> int | None:
         if isinstance(spec, ResourceMetricSpec):
             if self.resource_metrics is None:
                 return None
@@ -211,6 +341,34 @@ class HPAController:
             value = sum(utils) / len(utils)
             self.status.last_metric_values[f"resource/{spec.resource}"] = value
             target = spec.target_average_utilization
+        elif isinstance(spec, PodsMetricSpec):
+            if self.adapter is None or self.pod_lister is None:
+                return None
+            pods = self.pod_lister.ready_pod_names()
+            values = self.adapter.get_pods_metric(
+                self.namespace, spec.metric_name, pods
+            )
+            if not values:
+                return None
+            value = sum(values.values()) / len(values)
+            self.status.last_metric_values[f"pods/{spec.metric_name}"] = value
+            target = spec.target_average_value
+        elif isinstance(spec, ExternalMetricSpec):
+            if self.adapter is None:
+                return None
+            series = self.adapter.get_external_metric(
+                spec.namespace, spec.metric_name, spec.selector
+            )
+            if not series:
+                return None
+            total = sum(series)
+            self.status.last_metric_values[f"external/{spec.metric_name}"] = total
+            if spec.target_average_value is not None:
+                value = total / max(1, current)
+                target = spec.target_average_value
+            else:
+                value = total
+                target = spec.target_value
         else:
             if self.adapter is None:
                 return None
@@ -220,6 +378,8 @@ class HPAController:
             if value is None:
                 return None
             self.status.last_metric_values[spec.metric_name] = value
+            if spec.average:  # target.type: AverageValue — per-replica compare
+                value = value / max(1, current)
             target = spec.target_value
         ratio = value / target
         if abs(ratio - 1.0) <= self.TOLERANCE:

@@ -21,7 +21,12 @@ from dataclasses import dataclass
 
 from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
-from k8s_gpu_hpa_tpu.control.hpa import HPABehavior, HPAController, ObjectMetricSpec
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPABehavior,
+    HPAController,
+    MetricSpec,
+    ObjectMetricSpec,
+)
 from k8s_gpu_hpa_tpu.metrics.rules import (
     RecordingRule,
     RuleEvaluator,
@@ -56,6 +61,8 @@ class AutoscalingPipeline:
         extra_rules: list[RecordingRule] | None = None,
         replica_quantum: int = 1,
         object_kind: str = "Deployment",  # "Deployment" | "StatefulSet"
+        metric_specs: list[MetricSpec] | None = None,
+        extra_adapter_rules: list[AdapterRule] | None = None,
     ):
         self.cluster = cluster
         self.deployment = deployment
@@ -93,8 +100,16 @@ class AutoscalingPipeline:
         def overrides_for(rule: RecordingRule) -> dict[str, str]:
             # each rule's series is addressed at whatever object kind its own
             # output labels name (mixing deployment- and statefulset-scoped
-            # rules in one pipeline must keep both resolvable)
-            kind = "StatefulSet" if "statefulset" in rule.labels else "Deployment"
+            # rules in one pipeline must keep both resolvable); a rule with NO
+            # static output labels is per-pod (tpu_test_pod_max_rule) and is
+            # addressed at pods so Pods-type metrics resolve without callers
+            # hand-wiring a duplicate AdapterRule
+            if "statefulset" in rule.labels:
+                kind = "StatefulSet"
+            elif rule.labels:
+                kind = "Deployment"
+            else:
+                kind = "Pod"
             return {"namespace": "namespace", kind.lower(): kind}
 
         self.adapter = CustomMetricsAdapter(
@@ -102,13 +117,27 @@ class AutoscalingPipeline:
             [
                 AdapterRule(series=r.record, resource_overrides=overrides_for(r))
                 for r in rules
-            ],
+            ]
+            + (extra_adapter_rules or []),
         )
 
         ref = ObjectReference(object_kind, deployment.name, deployment.namespace)
+        # Fail loudly on a namespace mismatch: an Object/External spec parsed
+        # against the wrong namespace would otherwise match nothing and the
+        # HPA would silently hold forever (pass namespace= to
+        # metrics_from_manifest when the deployment is not in "default").
+        for spec in metric_specs or []:
+            ns = getattr(
+                getattr(spec, "described_object", None), "namespace", None
+            ) or getattr(spec, "namespace", None)
+            if ns is not None and ns != deployment.namespace:
+                raise ValueError(
+                    f"metric spec {spec} addresses namespace {ns!r} but the "
+                    f"deployment is in {deployment.namespace!r}"
+                )
         self.hpa = HPAController(
             target=deployment,
-            metrics=[ObjectMetricSpec(record, target_value, ref)],
+            metrics=metric_specs or [ObjectMetricSpec(record, target_value, ref)],
             adapter=self.adapter,
             clock=clock,
             min_replicas=min_replicas,
@@ -116,6 +145,8 @@ class AutoscalingPipeline:
             behavior=behavior,
             sync_interval=self.intervals.hpa_sync,
             replica_quantum=replica_quantum,
+            pod_lister=deployment,
+            namespace=deployment.namespace,
         )
         self.scale_history: list[tuple[float, int, int]] = []  # (ts, from, to)
         self.hpa.on_scale = lambda a, b: self.scale_history.append((clock.now(), a, b))

@@ -15,6 +15,7 @@ the steady loop.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from k8s_gpu_hpa_tpu.loadgen.knob import IntensityKnob
 from k8s_gpu_hpa_tpu.models.resnet import resnet18ish, resnet50
 from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
@@ -147,3 +149,46 @@ class TrainLoadGen:
 
     def utilization(self, _chip_index: int = 0) -> float:
         return self.stats().utilization
+
+
+def main() -> None:
+    """``python -m k8s_gpu_hpa_tpu.loadgen.train`` — the tpu-train container
+    command (deploy/tpu-train-deployment.yaml, BASELINE configs[3]).
+
+    Training runs continuously with the shared duty-cycle knob between steps
+    (same three ways to set it as the matmul generator: TPU_TEST_INTENSITY env,
+    the watched intensity file, or API).  Env: BATCH_SIZE, IMAGE_SIZE,
+    SMALL_MODEL=1 for the reduced-depth model, REPORT_S.
+    """
+    batch = int(os.environ.get("BATCH_SIZE", "256"))
+    image = int(os.environ.get("IMAGE_SIZE", "32"))
+    small = os.environ.get("SMALL_MODEL", "0") == "1"
+    report_every = float(os.environ.get("REPORT_S", "10"))
+    knob = IntensityKnob()
+    gen = TrainLoadGen(batch_size=batch, image_size=image, small=small)
+    gen.warmup()
+    print(
+        f"tpu-train loadgen: ResNet-{'18ish' if small else '50'} "
+        f"batch={batch} image={image} on {jax.devices()[0].device_kind}, "
+        f"intensity={knob.value} (knob: {knob.file})",
+        flush=True,
+    )
+    last_report = time.perf_counter()
+    while True:
+        if knob.poll() <= 0.0:
+            knob.throttle(0.0)
+        else:
+            busy = gen.step()
+            knob.throttle(busy)
+        if time.perf_counter() - last_report >= report_every:
+            s = gen.stats()
+            print(
+                f"steps={s.steps} imgs/s={s.images_per_sec:.1f} "
+                f"loss={s.last_loss:.3f} util={s.utilization:.1f}%",
+                flush=True,
+            )
+            last_report = time.perf_counter()
+
+
+if __name__ == "__main__":
+    main()

@@ -219,6 +219,30 @@ def tpu_test_avg_rule(
     )
 
 
+def tpu_test_pod_max_rule(
+    app: str = "tpu-test",
+    metric: str = "tpu_hbm_memory_usage_bytes",
+    record: str = "tpu_test_hbm_used_bytes",
+) -> RecordingRule:
+    """Per-pod rule for Pods-type HPA metrics (BASELINE configs[2]): collapse
+    each pod's chips to the hottest chip (``max by(namespace,pod)`` — per-chip
+    semantics over a v5e-8 slice pod's 8 chips) and scope to the app via the
+    same ``kube_pod_labels`` join, but *keep* the per-pod label set instead of
+    averaging — the adapter addresses the result per pod
+    (``/namespaces/{ns}/pods/*/...``), and the HPA does the averaging with
+    AverageValue semantics (deploy/tpu-test-hbm-hpa.yaml)."""
+    expr = MulOnGroupLeft(
+        left=MaxBy(("namespace", "pod"), Select(metric)),
+        right=MaxBy(
+            ("pod", "label_app"),
+            Select("kube_pod_labels", {"label_app": app}),
+        ),
+        on=("pod",),
+        group_left=("label_app",),
+    )
+    return RecordingRule(record=record, expr=expr)
+
+
 def tpu_test_multihost_avg_rule(
     app: str = "tpu-test-multihost",
     statefulset: str = "tpu-test-multihost",

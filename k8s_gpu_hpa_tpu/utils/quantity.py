@@ -1,0 +1,40 @@
+"""Kubernetes resource-quantity parsing.
+
+HPA manifests express metric targets as Kubernetes quantities — plain numbers
+("40"), decimal-SI suffixed ("500m", "2k"), or binary-SI suffixed ("13Gi") —
+the same grammar used by the reference's resource requests
+(cuda-test-deployment.yaml:20-22 requests `nvidia.com/gpu: 1`).  The rebuild's
+HBM-usage HPA (deploy/tpu-test-hbm-hpa.yaml) needs byte quantities, so the
+controller parses the full grammar rather than assuming bare floats.
+"""
+
+from __future__ import annotations
+
+_BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
+_DECIMAL = {
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+}
+
+
+def parse_quantity(q: str | int | float) -> float:
+    """Parse a Kubernetes quantity into a float (bytes/cores/plain units)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = q.strip()
+    for suffix, mult in _BINARY.items():
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    # decimal suffixes are single characters; check longest-first is moot here,
+    # but exponent forms like "1e3" must not lose their trailing digit
+    if s and s[-1] in _DECIMAL and not s[-1].isdigit():
+        try:
+            return float(s[:-1]) * _DECIMAL[s[-1]]
+        except ValueError:
+            pass
+    return float(s)

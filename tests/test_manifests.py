@@ -15,6 +15,7 @@ from k8s_gpu_hpa_tpu.control.hpa import behavior_from_manifest, quantum_from_man
 from k8s_gpu_hpa_tpu.metrics.rules import (
     tpu_test_avg_rule,
     tpu_test_multihost_avg_rule,
+    tpu_test_pod_max_rule,
 )
 from k8s_gpu_hpa_tpu.metrics.schema import (
     TPU_DUTY_CYCLE,
@@ -107,6 +108,26 @@ def test_prometheusrule_exprs_generated_from_ast():
     assert mh["record"] == mh_rule.record
     assert mh["expr"] == mh_rule.expr.promql()
     assert mh["labels"] == mh_rule.labels
+    # per-pod HBM rung (BASELINE configs[2]): no static output labels — the
+    # per-pod label set IS the addressing scheme
+    hbm = groups["tpu-test-v5e8"]["rules"][0]
+    hbm_rule = tpu_test_pod_max_rule(
+        app="tpu-test-v5e8", record="tpu_test_hbm_used_bytes"
+    )
+    assert hbm["record"] == hbm_rule.record
+    assert hbm["expr"] == hbm_rule.expr.promql()
+    assert "labels" not in hbm
+    # training rung (BASELINE configs[3])
+    train_rules = {r["record"]: r for r in groups["tpu-train"]["rules"]}
+    for record, metric in [
+        ("tpu_train_duty_cycle_avg", TPU_DUTY_CYCLE),
+        ("tpu_train_hbm_bw_avg", TPU_HBM_BW_UTIL),
+    ]:
+        ast_rule = tpu_test_avg_rule(
+            app="tpu-train", deployment="tpu-train", metric=metric, record=record
+        )
+        assert train_rules[record]["expr"] == ast_rule.expr.promql()
+        assert train_rules[record]["labels"] == ast_rule.labels
 
 
 def test_adapter_rules_cover_all_recorded_series():
@@ -124,11 +145,34 @@ def test_adapter_rules_cover_all_recorded_series():
         overrides = r["resources"]["overrides"]
         assert overrides["namespace"] == {"resource": "namespace"}
         # each series is addressed at the object kind its output label names
-        target = "statefulset" if "statefulset" in r["seriesQuery"] else "deployment"
+        # (deployment / statefulset Object metrics, or per-pod Pods metrics)
+        if "statefulset" in r["seriesQuery"]:
+            target = "statefulset"
+        elif 'pod!=""' in r["seriesQuery"]:
+            target = "pod"
+        else:
+            target = "deployment"
         assert overrides[target] == {"resource": target}
         # the output-label association trick requires the seriesQuery to
         # demand the label exists
         assert f'{target}!=""' in r["seriesQuery"]
+
+
+def test_new_rung_workload_contracts():
+    """The v5e-8 and training rung workloads: slice-sized TPU allotments, the
+    same app-label join-key discipline, and the loadgen entrypoints they run."""
+    v5e8 = load("tpu-test-v5e8-deployment.yaml")
+    tmpl = v5e8["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["app"] == "tpu-test-v5e8"
+    assert tmpl["spec"]["containers"][0]["resources"]["limits"]["google.com/tpu"] == 8
+    assert tmpl["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+
+    train = load("tpu-train-deployment.yaml")
+    tmpl = train["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["app"] == "tpu-train"
+    container = tmpl["spec"]["containers"][0]
+    assert container["command"] == ["python", "-m", "k8s_gpu_hpa_tpu.loadgen.train"]
+    assert container["resources"]["limits"]["google.com/tpu"] == 4
 
 
 def test_hpa_contracts():

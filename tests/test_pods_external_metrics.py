@@ -1,0 +1,425 @@
+"""Pods- and External-type HPA metrics (autoscaling/v2 metric-type coverage)
+plus the two BASELINE rungs built on them: the v5e-8 per-chip HBM-usage HPA
+(configs[2], deploy/tpu-test-hbm-hpa.yaml) and the ResNet-training multi-metric
+HPA (configs[3], deploy/tpu-train-hpa.yaml).  The reference only ever exercises
+the Object shape (cuda-test-hpa.yaml:13-21)."""
+
+from pathlib import Path
+
+import yaml
+
+from k8s_gpu_hpa_tpu.control.adapter import (
+    AdapterRule,
+    CustomMetricsAdapter,
+    ExternalRule,
+)
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import (
+    behavior_from_manifest,
+    ExternalMetricSpec,
+    HPAController,
+    metrics_from_manifest,
+    ObjectMetricSpec,
+    PodsMetricSpec,
+    ResourceMetricSpec,
+)
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.metrics.rules import tpu_test_avg_rule, tpu_test_pod_max_rule
+from k8s_gpu_hpa_tpu.metrics.schema import TPU_DUTY_CYCLE, TPU_HBM_BW_UTIL
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+from k8s_gpu_hpa_tpu.utils.quantity import parse_quantity
+
+DEPLOY = Path(__file__).resolve().parent.parent / "deploy"
+
+
+class FakeTarget:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+
+    def scale_to(self, n):
+        self.replicas = n
+
+
+class FakePodLister:
+    def __init__(self, names):
+        self.names = names
+
+    def ready_pod_names(self):
+        return self.names
+
+
+# ---- quantity grammar -------------------------------------------------------
+
+
+def test_parse_quantity_grammar():
+    assert parse_quantity("40") == 40.0
+    assert parse_quantity(40) == 40.0
+    assert parse_quantity("13Gi") == 13 * 2**30
+    assert parse_quantity("512Mi") == 512 * 2**20
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("2k") == 2000.0
+    assert parse_quantity("1e3") == 1000.0
+    assert parse_quantity("1.5") == 1.5
+
+
+# ---- manifest parsing -------------------------------------------------------
+
+
+def test_metrics_from_manifest_all_four_types():
+    doc = {
+        "spec": {
+            "metrics": [
+                {
+                    "type": "Object",
+                    "object": {
+                        "metric": {"name": "m_obj"},
+                        "describedObject": {"kind": "Deployment", "name": "d"},
+                        "target": {"type": "Value", "value": "40"},
+                    },
+                },
+                {
+                    "type": "Pods",
+                    "pods": {
+                        "metric": {"name": "m_pods"},
+                        "target": {"type": "AverageValue", "averageValue": "13Gi"},
+                    },
+                },
+                {
+                    "type": "Resource",
+                    "resource": {
+                        "name": "cpu",
+                        "target": {"type": "Utilization", "averageUtilization": 60},
+                    },
+                },
+                {
+                    "type": "External",
+                    "external": {
+                        "metric": {
+                            "name": "m_ext",
+                            "selector": {"matchLabels": {"queue": "q1"}},
+                        },
+                        "target": {"type": "AverageValue", "averageValue": "30"},
+                    },
+                },
+            ]
+        }
+    }
+    obj, pods, res, ext = metrics_from_manifest(doc)
+    assert isinstance(obj, ObjectMetricSpec) and obj.target_value == 40.0
+    assert isinstance(pods, PodsMetricSpec)
+    assert pods.target_average_value == 13 * 2**30
+    assert isinstance(res, ResourceMetricSpec) and res.resource == "cpu"
+    assert isinstance(ext, ExternalMetricSpec)
+    assert ext.selector == {"queue": "q1"} and ext.target_average_value == 30.0
+
+
+def test_object_average_value_target():
+    doc = {
+        "spec": {
+            "metrics": [
+                {
+                    "type": "Object",
+                    "object": {
+                        "metric": {"name": "m"},
+                        "describedObject": {"kind": "Deployment", "name": "d"},
+                        "target": {"type": "AverageValue", "averageValue": "30"},
+                    },
+                }
+            ]
+        }
+    }
+    (spec,) = metrics_from_manifest(doc)
+    assert spec.average and spec.target_value == 30.0
+    # semantics: object value divided by current replicas before comparing
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    db.append("m", (("namespace", "default"), ("deployment", "d")), 90.0)
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series="m")])
+    target = FakeTarget(replicas=1)
+    hpa = HPAController(
+        target=target, metrics=[spec], adapter=adapter, clock=clock, max_replicas=8
+    )
+    hpa.sync_once()
+    assert target.replicas == 3  # 90 per 1 replica / 30 -> 3
+    hpa.sync_once()
+    assert target.replicas == 3  # 90/3 = 30 = on target
+
+
+def test_resource_average_value_rejected_explicitly():
+    import pytest
+
+    doc = {
+        "spec": {
+            "metrics": [
+                {
+                    "type": "Resource",
+                    "resource": {
+                        "name": "memory",
+                        "target": {"type": "AverageValue", "averageValue": "1Gi"},
+                    },
+                }
+            ]
+        }
+    }
+    with pytest.raises(ValueError, match="Utilization"):
+        metrics_from_manifest(doc)
+
+
+def test_pipeline_rejects_namespace_mismatch():
+    import pytest
+
+    clock = VirtualClock()
+    cluster = SimCluster(clock)
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", namespace="prod", load_fn=lambda t: 0.0
+    )
+    cluster.add_deployment(dep, replicas=1)
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-hpa.yaml").read_text())
+    with pytest.raises(ValueError, match="namespace"):
+        AutoscalingPipeline(
+            cluster, dep, metric_specs=metrics_from_manifest(hpa_doc)
+        )
+
+
+def test_shipped_hbm_and_train_hpa_manifests_parse():
+    hbm = yaml.safe_load((DEPLOY / "tpu-test-hbm-hpa.yaml").read_text())
+    (spec,) = metrics_from_manifest(hbm)
+    assert isinstance(spec, PodsMetricSpec)
+    assert spec.metric_name == "tpu_test_hbm_used_bytes"
+    assert spec.target_average_value == 13 * 2**30
+
+    train = yaml.safe_load((DEPLOY / "tpu-train-hpa.yaml").read_text())
+    specs = metrics_from_manifest(train)
+    assert [s.metric_name for s in specs] == [
+        "tpu_train_duty_cycle_avg",
+        "tpu_train_hbm_bw_avg",
+    ]
+    assert all(isinstance(s, ObjectMetricSpec) for s in specs)
+
+
+# ---- Pods metric semantics --------------------------------------------------
+
+
+def _pods_fixture(pod_values: dict[str, float], listed: list[str]):
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    for pod, value in pod_values.items():
+        db.append(
+            "tpu_test_hbm_used_bytes",
+            (("namespace", "default"), ("pod", pod)),
+            value,
+        )
+    adapter = CustomMetricsAdapter(
+        db,
+        [
+            AdapterRule(
+                series="tpu_test_hbm_used_bytes",
+                resource_overrides={"namespace": "namespace", "pod": "Pod"},
+            )
+        ],
+    )
+    return clock, adapter
+
+
+def test_pods_metric_averages_over_reporting_pods():
+    clock, adapter = _pods_fixture({"a": 10.0, "b": 30.0}, ["a", "b", "c"])
+    target = FakeTarget(replicas=2)
+    hpa = HPAController(
+        target=target,
+        metrics=[PodsMetricSpec("tpu_test_hbm_used_bytes", 10.0)],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+        pod_lister=FakePodLister(["a", "b", "c"]),  # c has no fresh series
+    )
+    hpa.sync_once()
+    # avg over reporting pods = 20, target 10 -> ratio 2 -> 2*2=4
+    assert target.replicas == 4
+    assert hpa.status.last_metric_values["pods/tpu_test_hbm_used_bytes"] == 20.0
+
+
+def test_pods_metric_unavailable_holds():
+    clock, adapter = _pods_fixture({}, ["a"])
+    target = FakeTarget(replicas=3)
+    hpa = HPAController(
+        target=target,
+        metrics=[PodsMetricSpec("tpu_test_hbm_used_bytes", 10.0)],
+        adapter=adapter,
+        clock=clock,
+        pod_lister=FakePodLister(["a"]),
+    )
+    hpa.sync_once()
+    assert target.replicas == 3
+    assert "unavailable" in hpa.status.last_reason
+
+
+# ---- External metric semantics ---------------------------------------------
+
+
+def _external_fixture(values: dict[str, float]):
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    for queue, value in values.items():
+        db.append(
+            "queue_backlog",
+            (("namespace", "default"), ("queue", queue)),
+            value,
+        )
+    adapter = CustomMetricsAdapter(
+        db, [], external_rules=[ExternalRule(series="queue_backlog")]
+    )
+    return clock, adapter
+
+
+def test_external_metric_value_target_sums_matched_series():
+    clock, adapter = _external_fixture({"q1": 60.0, "q2": 40.0})
+    target = FakeTarget(replicas=1)
+    hpa = HPAController(
+        target=target,
+        metrics=[ExternalMetricSpec("queue_backlog", target_value=50.0)],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+    )
+    hpa.sync_once()
+    # sum = 100, target 50 -> ratio 2 -> 2 replicas
+    assert target.replicas == 2
+    assert adapter.list_external_metrics() == ["queue_backlog"]
+
+
+def test_external_metric_selector_scopes_series():
+    clock, adapter = _external_fixture({"q1": 60.0, "q2": 40.0})
+    target = FakeTarget(replicas=1)
+    hpa = HPAController(
+        target=target,
+        metrics=[
+            ExternalMetricSpec(
+                "queue_backlog", selector={"queue": "q2"}, target_value=10.0
+            )
+        ],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+    )
+    hpa.sync_once()
+    assert target.replicas == 4  # 40/10
+
+
+def test_external_metric_average_value_divides_by_replicas():
+    clock, adapter = _external_fixture({"q1": 90.0})
+    target = FakeTarget(replicas=1)
+    hpa = HPAController(
+        target=target,
+        metrics=[ExternalMetricSpec("queue_backlog", target_average_value=30.0)],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+    )
+    hpa.sync_once()
+    assert target.replicas == 3  # 90 per replica / 30 -> 3
+    hpa.sync_once()
+    assert target.replicas == 3  # 30 per replica = on target; stable
+
+
+def test_external_spec_requires_exactly_one_target():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ExternalMetricSpec("m")
+    with pytest.raises(ValueError):
+        ExternalMetricSpec("m", target_value=1.0, target_average_value=1.0)
+
+
+# ---- closed-loop rungs on the shipped manifests -----------------------------
+
+
+def test_hbm_pods_rung_scales_1_to_4_on_shipped_manifests():
+    """BASELINE configs[2]: v5e-8 slice pods (8 chips each), Pods-type HPA on
+    per-chip HBM usage from deploy/tpu-test-hbm-hpa.yaml.  The sim's HBM model
+    fills with utilization (cluster.py::_collect), so a load spike drives the
+    hottest chip past the 13Gi AverageValue target and the loop scales out."""
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("tpu-node-0", 16), ("tpu-node-1", 16)])
+    deployment = SimDeployment(
+        cluster,
+        name="tpu-test-v5e8",
+        app_label="tpu-test-v5e8",
+        chips_per_pod=8,
+        load_fn=lambda t: 350.0 if t >= 100.0 else 20.0,
+    )
+    cluster.add_deployment(deployment, replicas=1)
+    clock.advance(15.0)
+
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-hbm-hpa.yaml").read_text())
+    pipeline = AutoscalingPipeline(
+        cluster,
+        deployment,
+        metric_specs=metrics_from_manifest(hpa_doc),
+        behavior=behavior_from_manifest(hpa_doc),
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        extra_rules=[
+            # label-free per-pod rule: the pipeline auto-addresses it at pods
+            tpu_test_pod_max_rule(
+                app="tpu-test-v5e8", record="tpu_test_hbm_used_bytes"
+            )
+        ],
+    )
+    pipeline.run_for(80.0)
+    assert pipeline.replicas() == 1  # idle HBM well below 13Gi
+    pipeline.run_for(120.0)
+    assert pipeline.replicas() == 4
+    assert pipeline.running() == 4
+    # each replica consumed a whole 8-chip slice
+    total_allocated = sum(
+        len(n.allocations) for n in pipeline.cluster.nodes.values()
+    )
+    assert total_allocated == 32
+
+
+def test_train_multimetric_rung_scales_on_shipped_manifests():
+    """BASELINE configs[3]: the training deployment's multi-metric HPA (duty
+    cycle + HBM bandwidth Object metrics from deploy/tpu-train-hpa.yaml); the
+    controller takes the max proposal across the two."""
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("tpu-node-0", 16)])
+    deployment = SimDeployment(
+        cluster,
+        name="tpu-train",
+        app_label="tpu-train",
+        chips_per_pod=4,
+        load_fn=lambda t: 300.0 if t >= 100.0 else 10.0,
+    )
+    cluster.add_deployment(deployment, replicas=1)
+    clock.advance(15.0)
+
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-train-hpa.yaml").read_text())
+    pipeline = AutoscalingPipeline(
+        cluster,
+        deployment,
+        metric_specs=metrics_from_manifest(hpa_doc),
+        behavior=behavior_from_manifest(hpa_doc),
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        extra_rules=[
+            tpu_test_avg_rule(
+                app="tpu-train",
+                deployment="tpu-train",
+                metric=TPU_DUTY_CYCLE,
+                record="tpu_train_duty_cycle_avg",
+            ),
+            tpu_test_avg_rule(
+                app="tpu-train",
+                deployment="tpu-train",
+                metric=TPU_HBM_BW_UTIL,
+                record="tpu_train_hbm_bw_avg",
+            ),
+        ],
+    )
+    pipeline.run_for(80.0)
+    assert pipeline.replicas() == 1
+    pipeline.run_for(120.0)
+    assert pipeline.replicas() == 4
+    # both metrics were observed by the controller
+    values = pipeline.hpa.status.last_metric_values
+    assert "tpu_train_duty_cycle_avg" in values
+    assert "tpu_train_hbm_bw_avg" in values

@@ -124,14 +124,18 @@ def test_cpu_rung_closed_loop_in_simulation():
     clock = VirtualClock()
     cluster = SimCluster(clock, nodes=[("node-0", 0)], pod_start_latency=3.0)
 
-    # CPU pods claim no chips; give them per-pod load like the busyloop
+    # CPU pods claim no chips.  The shipped busyloop (`while :; do :; done`,
+    # deploy/cpu-busyloop.yaml) spins every replica flat-out regardless of
+    # replica count — the reference's vectorAdd shape — so model it per_pod:
+    # post-spike every pod reports the same high utilization and the HPA
+    # rides to maxReplicas and pins there (no shared-load equilibrium).
     dep = SimDeployment(
         cluster,
         "cpu-busyloop",
         "cpu-busyloop",
         chips_per_pod=0,
-        load_fn=lambda t: 300.0 if t >= 30.0 else 20.0,
-        load_mode="shared",
+        load_fn=lambda t: 100.0 if t >= 30.0 else 20.0,
+        load_mode="per_pod",
     )
     cluster.add_deployment(dep, replicas=1)
     clock.advance(5.0)
@@ -158,7 +162,7 @@ def test_cpu_rung_closed_loop_in_simulation():
     assert dep.replicas == 1
     sync_every_15s(120.0)
     assert dep.replicas == 4
-    # load spread over 4 pods: 75% avg vs 60 target -> ratio 1.25, scale
-    # capped at max; stays there
+    # every pod still reports 100% vs 60 target -> pinned at maxReplicas,
+    # exactly how the busyloop behaves on a real cluster
     sync_every_15s(240.0)
     assert dep.replicas == 4

@@ -18,6 +18,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from k8s_gpu_hpa_tpu.metrics.rules import (
     tpu_test_avg_rule,
     tpu_test_multihost_avg_rule,
+    tpu_test_pod_max_rule,
 )
 from k8s_gpu_hpa_tpu.metrics.schema import (
     TPU_DUTY_CYCLE,
@@ -65,9 +66,10 @@ def _render_rule(rule, comment=None) -> str:
         out.append(f"        {comment}\n")
     out.append(f"        - record: {rule.record}\n")
     out.append(f"          expr: {rule.expr.promql()}\n")
-    out.append("          labels:\n")
-    for k, v in rule.labels.items():
-        out.append(f"            {k}: {v}\n")
+    if rule.labels:
+        out.append("          labels:\n")
+        for k, v in rule.labels.items():
+            out.append(f"            {k}: {v}\n")
     return "".join(out)
 
 
@@ -75,6 +77,43 @@ def render() -> str:
     out = [HEADER]
     for record, metric, comment in RULES:
         out.append(_render_rule(tpu_test_avg_rule(metric=metric, record=record), comment))
+    out.append(
+        "    # per-pod HBM rung (BASELINE configs[2]): the v5e-8 slice pod's 8\n"
+        "    # chips collapse to the hottest chip, output stays per-pod - the\n"
+        "    # adapter serves it as a Pods metric and the HPA averages with an\n"
+        "    # AverageValue target (deploy/tpu-test-hbm-hpa.yaml)\n"
+        "    - name: tpu-test-v5e8\n"
+        "      interval: 1s\n"
+        "      rules:\n"
+    )
+    out.append(
+        _render_rule(
+            tpu_test_pod_max_rule(
+                app="tpu-test-v5e8", record="tpu_test_hbm_used_bytes"
+            )
+        )
+    )
+    out.append(
+        "    # training rung (BASELINE configs[3]): ResNet-50 training pod,\n"
+        "    # multi-metric HPA on duty cycle + HBM bandwidth\n"
+        "    - name: tpu-train\n"
+        "      interval: 1s\n"
+        "      rules:\n"
+    )
+    for record, metric in [
+        ("tpu_train_duty_cycle_avg", TPU_DUTY_CYCLE),
+        ("tpu_train_hbm_bw_avg", TPU_HBM_BW_UTIL),
+    ]:
+        out.append(
+            _render_rule(
+                tpu_test_avg_rule(
+                    app="tpu-train",
+                    deployment="tpu-train",
+                    metric=metric,
+                    record=record,
+                )
+            )
+        )
     out.append(
         "    # multi-host rung (BASELINE configs[4]): per-host pods of the\n"
         "    # StatefulSet-of-slices, addressed at the StatefulSet object\n"
