@@ -78,7 +78,8 @@ class ExternalMetricSpec:
     selector: dict[str, str] = field(default_factory=dict)
     target_value: float | None = None
     target_average_value: float | None = None
-    namespace: str = "default"
+    #: None inherits the controller's namespace (the HPA object's own)
+    namespace: str | None = None
 
     def __post_init__(self) -> None:
         if (self.target_value is None) == (self.target_average_value is None):
@@ -357,7 +358,7 @@ class HPAController:
             if self.adapter is None:
                 return None
             series = self.adapter.get_external_metric(
-                spec.namespace, spec.metric_name, spec.selector
+                spec.namespace or self.namespace, spec.metric_name, spec.selector
             )
             if not series:
                 return None

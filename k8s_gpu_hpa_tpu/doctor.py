@@ -1,0 +1,259 @@
+"""Pipeline doctor: the runbook's per-joint probes as one command.
+
+The reference's only test story is four manual curl probes interleaved with
+install steps — exporter text (README.md:42-47), Prometheus query
+(README.md:80-88), custom-metrics raw API (README.md:98-102), and the final
+scale-up watch (README.md:112-121) — with the discipline "don't advance past a
+failing probe" implicit in the step ordering.  This module makes that
+discipline executable: an ordered list of probes, one per string-contract
+joint (SURVEY.md §1), that stops at the first broken joint and says which
+contract broke.
+
+Two frontends share the probe definitions:
+
+- ``diagnose(fetchers)`` takes plain callables (used by tests against the
+  in-process harness, and by ``main()`` with HTTP/kubectl fetchers);
+- ``python -m k8s_gpu_hpa_tpu.doctor`` probes a real cluster: the exporter
+  service, the Prometheus API, and ``kubectl get --raw`` for the aggregated
+  custom-metrics API.
+
+Env for the CLI: EXPORTER_URL (default http://localhost:9400/metrics),
+PROM_URL (default http://localhost:9090), METRIC (default
+tpu_test_tensorcore_avg), DEPLOYMENT / NAMESPACE for the HPA check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Callable
+
+from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
+
+
+@dataclass
+class ProbeResult:
+    name: str
+    ok: bool
+    detail: str
+
+
+@dataclass
+class Probe:
+    """One joint check.  ``fetch`` pulls raw data; ``check`` returns a detail
+    string on success and raises (or returns None via assert) on failure."""
+
+    name: str
+    description: str
+    run: Callable[[], str]
+
+
+def check_exporter_text(text: str) -> str:
+    """L2 joint: the exporter serves fresh per-chip gauges with attribution
+    labels (the probe of README.md:42-47, upgraded from 'greps one metric' to
+    checking the contract the rules depend on)."""
+    fams = {f.name: f for f in parse_text(text)}
+    up = fams.get("tpu_metrics_exporter_up")
+    if up is None or not up.samples:
+        raise AssertionError("tpu_metrics_exporter_up missing from exposition")
+    if up.samples[0].value != 1.0:
+        raise AssertionError(
+            "tpu_metrics_exporter_up=0: exporter is serving but its metric "
+            "source is stale (no fresh sweep within the staleness window)"
+        )
+    missing = [m for m in CHIP_METRICS if m not in fams or not fams[m].samples]
+    if missing:
+        raise AssertionError(f"chip metric families missing/empty: {missing}")
+    sample = fams["tpu_tensorcore_utilization"].samples[0]
+    for label in ("node", "chip"):
+        if sample.label(label) is None:
+            raise AssertionError(f"per-chip samples lack the {label!r} label")
+    n = len(fams["tpu_tensorcore_utilization"].samples)
+    attributed = sum(
+        1 for s in fams["tpu_tensorcore_utilization"].samples if s.label("pod")
+    )
+    return f"{n} chips exported, {attributed} attributed to pods"
+
+
+def check_prom_vector(payload: str, metric: str) -> str:
+    """L3 joint: the recorded series exists with its addressing labels (the
+    probe of README.md:80-88).  ``payload`` is the Prometheus instant-query
+    JSON response body."""
+    doc = json.loads(payload)
+    if doc.get("status") != "success":
+        raise AssertionError(f"prometheus query failed: {doc}")
+    results = doc["data"]["result"]
+    if not results:
+        raise AssertionError(
+            f"series {metric} absent: scrape job, recording rule, or the "
+            "kube_pod_labels join is broken (or the workload isn't running — "
+            "deploy it first, README ordering)"
+        )
+    labels = results[0]["metric"]
+    addressed = {k: v for k, v in labels.items() if k in ("namespace", "deployment", "statefulset", "pod")}
+    if "namespace" not in addressed or len(addressed) < 2:
+        raise AssertionError(
+            f"series {metric} lacks object-addressing labels (got {labels}); "
+            "prometheus-adapter cannot associate it with a Kubernetes object"
+        )
+    value = results[0]["value"][1]
+    return f"{metric}={value} {addressed}"
+
+
+def check_custom_metrics_api(payload: str, metric: str) -> str:
+    """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
+    doc = json.loads(payload)
+    names = {r.get("name", "") for r in doc.get("resources", [])}
+    if not any(metric in n for n in names):
+        raise AssertionError(
+            f"{metric} not in custom.metrics.k8s.io discovery "
+            f"({len(names)} resources); adapter rules config is broken or the "
+            "series has gone stale upstream"
+        )
+    return f"{metric} discoverable among {len(names)} resources"
+
+
+def check_hpa_status(payload: str) -> str:
+    """L5 joint: the HPA read the metric (AbleToScale/ScalingActive true)."""
+    doc = json.loads(payload)
+    conditions = {
+        c["type"]: c for c in doc.get("status", {}).get("conditions", [])
+    }
+    active = conditions.get("ScalingActive")
+    if active is None:
+        raise AssertionError("HPA has no ScalingActive condition yet")
+    if active.get("status") != "True":
+        raise AssertionError(
+            f"ScalingActive={active.get('status')}: {active.get('reason')} — "
+            f"{active.get('message')}"
+        )
+    cur = doc.get("status", {}).get("currentReplicas")
+    des = doc.get("status", {}).get("desiredReplicas")
+    return f"ScalingActive, replicas current={cur} desired={des}"
+
+
+def diagnose(
+    exporter_fetch: Callable[[], str] | None = None,
+    prom_fetch: Callable[[], str] | None = None,
+    api_fetch: Callable[[], str] | None = None,
+    hpa_fetch: Callable[[], str] | None = None,
+    metric: str = "tpu_test_tensorcore_avg",
+) -> list[ProbeResult]:
+    """Run the ordered joint probes, stopping at the first failure (the
+    runbook discipline).  Fetchers set to None are skipped — e.g. tests
+    without a kubectl."""
+    checks: list[tuple[str, str, Callable[[], str] | None]] = [
+        (
+            "L2 exporter",
+            "per-chip gauges fresh with node/pod attribution",
+            (lambda: check_exporter_text(exporter_fetch()))
+            if exporter_fetch
+            else None,
+        ),
+        (
+            "L3 prometheus",
+            f"recorded series {metric} exists and is object-addressed",
+            (lambda: check_prom_vector(prom_fetch(), metric)) if prom_fetch else None,
+        ),
+        (
+            "L4 custom-metrics API",
+            f"{metric} discoverable on custom.metrics.k8s.io",
+            (lambda: check_custom_metrics_api(api_fetch(), metric))
+            if api_fetch
+            else None,
+        ),
+        (
+            "L5 HPA",
+            "HPA is reading the metric (ScalingActive)",
+            (lambda: check_hpa_status(hpa_fetch())) if hpa_fetch else None,
+        ),
+    ]
+    results: list[ProbeResult] = []
+    for name, description, run in checks:
+        if run is None:
+            results.append(ProbeResult(name, True, "skipped (no fetcher)"))
+            continue
+        try:
+            detail = run()
+        except Exception as e:  # noqa: BLE001 — any failure is a diagnosis
+            results.append(ProbeResult(name, False, f"{description}: {e}"))
+            break  # don't advance past a failing probe
+        results.append(ProbeResult(name, True, detail))
+    return results
+
+
+def _http_fetch(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def _kubectl_raw(path: str) -> str:
+    import subprocess
+
+    return subprocess.run(
+        ["kubectl", "get", "--raw", path],
+        check=True,
+        capture_output=True,
+        text=True,
+    ).stdout
+
+
+def main() -> int:
+    exporter_url = os.environ.get("EXPORTER_URL", "http://localhost:9400/metrics")
+    prom_url = os.environ.get("PROM_URL", "http://localhost:9090")
+    metric = os.environ.get("METRIC", "tpu_test_tensorcore_avg")
+    namespace = os.environ.get("NAMESPACE", "default")
+    hpa_name = os.environ.get("HPA", "tpu-test")
+    have_kubectl = _which("kubectl")
+
+    from urllib.parse import quote
+
+    results = diagnose(
+        exporter_fetch=lambda: _http_fetch(exporter_url),
+        prom_fetch=lambda: _http_fetch(
+            f"{prom_url}/api/v1/query?query={quote(metric)}"
+        ),
+        api_fetch=(
+            (lambda: _kubectl_raw("/apis/custom.metrics.k8s.io/v1beta1"))
+            if have_kubectl
+            else None
+        ),
+        hpa_fetch=(
+            (
+                lambda: _kubectl_raw(
+                    f"/apis/autoscaling/v2/namespaces/{namespace}"
+                    f"/horizontalpodautoscalers/{hpa_name}"
+                )
+            )
+            if have_kubectl
+            else None
+        ),
+        metric=metric,
+    )
+    broken = False
+    for r in results:
+        mark = "ok " if r.ok else "FAIL"
+        print(f"[{mark}] {r.name}: {r.detail}")
+        broken = broken or not r.ok
+    if broken:
+        print(
+            "\npipeline broken at the first FAILing joint above; fix it "
+            "before looking further down the stack (each layer only consumes "
+            "the one below)"
+        )
+    return 1 if broken else 0
+
+
+def _which(cmd: str) -> bool:
+    import shutil
+
+    return shutil.which(cmd) is not None
+
+
+if __name__ == "__main__":
+    sys.exit(main())

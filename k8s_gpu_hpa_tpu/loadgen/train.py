@@ -150,6 +150,67 @@ class TrainLoadGen:
     def utilization(self, _chip_index: int = 0) -> float:
         return self.stats().utilization
 
+    # ---- checkpoint / resume (orbax) ---------------------------------------
+    #
+    # The reference's workload is stateless (vectorAdd,
+    # cuda-test-deployment.yaml:19) and SURVEY.md §5 records checkpoint/resume
+    # as ABSENT; a *training* pod being autoscaled loses work on every
+    # scale-down unless it checkpoints.  Orbax is the TPU-native answer: it
+    # writes sharded arrays directly and restores onto the same mesh.
+
+    def checkpoint_state(self) -> dict:
+        return {
+            "params": self.params,
+            "batch_stats": self.batch_stats,
+            "opt_state": self.opt_state,
+            "key": self._key,
+            "step": self._steps,
+            # cumulative busy seconds travels too, or a resumed pod's
+            # images_per_sec (steps*batch/busy) would be inflated ~stepcount-fold
+            "busy": self._busy,
+        }
+
+    def save_checkpoint(self, manager) -> None:
+        """Persist model/optimizer/RNG state at the current step via an
+        ``orbax.checkpoint.CheckpointManager`` (rotation + atomicity)."""
+        import orbax.checkpoint as ocp
+
+        manager.save(self._steps, args=ocp.args.StandardSave(self.checkpoint_state()))
+
+    def restore_checkpoint(self, manager) -> bool:
+        """Resume from the newest checkpoint; False when none exists.  The
+        live state serves as the restore template so optimizer pytree
+        structure (optax namedtuples) survives the round-trip."""
+        import orbax.checkpoint as ocp
+
+        latest = manager.latest_step()
+        if latest is None:
+            return False
+        restored = manager.restore(
+            latest, args=ocp.args.StandardRestore(self.checkpoint_state())
+        )
+        # Re-place onto this process's mesh: orbax restores committed to
+        # specific devices, and a committed single-device leaf (the RNG key)
+        # would conflict with mesh-replicated params inside the jitted step.
+        replicated = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(restored["params"], replicated)
+        self.batch_stats = jax.device_put(restored["batch_stats"], replicated)
+        self.opt_state = jax.device_put(restored["opt_state"], replicated)
+        self._key = jax.device_put(restored["key"], replicated)
+        self._steps = int(restored["step"])
+        self._busy = float(restored["busy"])
+        return True
+
+
+def make_checkpoint_manager(directory: str, max_to_keep: int = 2):
+    """CheckpointManager on a directory (the pod would mount a PVC/GCS-FUSE
+    path here); keeps the newest ``max_to_keep`` steps."""
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        directory, options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep)
+    )
+
 
 def main() -> None:
     """``python -m k8s_gpu_hpa_tpu.loadgen.train`` — the tpu-train container
@@ -158,14 +219,23 @@ def main() -> None:
     Training runs continuously with the shared duty-cycle knob between steps
     (same three ways to set it as the matmul generator: TPU_TEST_INTENSITY env,
     the watched intensity file, or API).  Env: BATCH_SIZE, IMAGE_SIZE,
-    SMALL_MODEL=1 for the reduced-depth model, REPORT_S.
+    SMALL_MODEL=1 for the reduced-depth model, REPORT_S; CHECKPOINT_DIR
+    enables resume-on-restart with a save every CHECKPOINT_EVERY steps
+    (scale-down kills pods — checkpointing makes that loss-free).
     """
     batch = int(os.environ.get("BATCH_SIZE", "256"))
     image = int(os.environ.get("IMAGE_SIZE", "32"))
     small = os.environ.get("SMALL_MODEL", "0") == "1"
     report_every = float(os.environ.get("REPORT_S", "10"))
+    ckpt_dir = os.environ.get("CHECKPOINT_DIR", "")
+    ckpt_every = int(os.environ.get("CHECKPOINT_EVERY", "100"))
     knob = IntensityKnob()
     gen = TrainLoadGen(batch_size=batch, image_size=image, small=small)
+    manager = None
+    if ckpt_dir:
+        manager = make_checkpoint_manager(ckpt_dir)
+        if gen.restore_checkpoint(manager):
+            print(f"resumed from step {gen.stats().steps} in {ckpt_dir}", flush=True)
     gen.warmup()
     print(
         f"tpu-train loadgen: ResNet-{'18ish' if small else '50'} "
@@ -174,12 +244,16 @@ def main() -> None:
         flush=True,
     )
     last_report = time.perf_counter()
+    last_ckpt_step = gen.stats().steps
     while True:
         if knob.poll() <= 0.0:
             knob.throttle(0.0)
         else:
             busy = gen.step()
             knob.throttle(busy)
+        if manager is not None and gen.stats().steps - last_ckpt_step >= ckpt_every:
+            gen.save_checkpoint(manager)
+            last_ckpt_step = gen.stats().steps
         if time.perf_counter() - last_report >= report_every:
             s = gen.stats()
             print(

@@ -30,11 +30,9 @@ def parse_quantity(q: str | int | float) -> float:
     for suffix, mult in _BINARY.items():
         if s.endswith(suffix):
             return float(s[: -len(suffix)]) * mult
-    # decimal suffixes are single characters; check longest-first is moot here,
-    # but exponent forms like "1e3" must not lose their trailing digit
-    if s and s[-1] in _DECIMAL and not s[-1].isdigit():
+    if s and s[-1] in _DECIMAL:
         try:
             return float(s[:-1]) * _DECIMAL[s[-1]]
         except ValueError:
-            pass
+            pass  # e.g. a bare "m" or malformed number: fall through
     return float(s)

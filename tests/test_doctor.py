@@ -1,0 +1,182 @@
+"""Doctor probes: the runbook's manual curl tests (README.md:42-47, 80-88,
+98-102, 112-121) as executable checks, each validating one string-contract
+joint and stopping at the first broken one."""
+
+import json
+
+import pytest
+
+from k8s_gpu_hpa_tpu.doctor import (
+    check_custom_metrics_api,
+    check_exporter_text,
+    check_hpa_status,
+    check_prom_vector,
+    diagnose,
+)
+from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+from k8s_gpu_hpa_tpu.exporter.podresources import StaticAttributor
+from k8s_gpu_hpa_tpu.exporter.sources import StubSource
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    ChipSample,
+    MetricFamily,
+    families_from_chips,
+)
+
+
+def exposition(up=1.0, chips=2, attributed=True):
+    samples = [ChipSample(i, 50.0, 55.0, 8e9, 16e9, 30.0) for i in range(chips)]
+    attribution = (
+        {i: ("default", f"tpu-test-{i}") for i in range(chips)} if attributed else {}
+    )
+    fams = families_from_chips(samples, node="n0", attribution=attribution)
+    up_fam = MetricFamily("tpu_metrics_exporter_up", "gauge")
+    up_fam.add(up, node="n0")
+    return encode_text(fams + [up_fam])
+
+
+def prom_payload(results):
+    return json.dumps(
+        {"status": "success", "data": {"result": results}}
+    )
+
+
+# ---- individual joint checks ------------------------------------------------
+
+
+def test_exporter_check_happy():
+    detail = check_exporter_text(exposition())
+    assert "2 chips" in detail and "2 attributed" in detail
+
+
+def test_exporter_check_flags_staleness():
+    with pytest.raises(AssertionError, match="stale"):
+        check_exporter_text(exposition(up=0.0))
+
+
+def test_exporter_check_flags_missing_up():
+    fams = families_from_chips(
+        [ChipSample(0, 1, 1, 1, 1, 1)], node="n0", attribution={}
+    )
+    with pytest.raises(AssertionError, match="tpu_metrics_exporter_up"):
+        check_exporter_text(encode_text(fams))
+
+
+def test_prom_check_happy():
+    payload = prom_payload(
+        [
+            {
+                "metric": {
+                    "__name__": "tpu_test_tensorcore_avg",
+                    "namespace": "default",
+                    "deployment": "tpu-test",
+                },
+                "value": [1700000000, "42.5"],
+            }
+        ]
+    )
+    detail = check_prom_vector(payload, "tpu_test_tensorcore_avg")
+    assert "42.5" in detail
+
+
+def test_prom_check_flags_absent_series():
+    with pytest.raises(AssertionError, match="absent"):
+        check_prom_vector(prom_payload([]), "tpu_test_tensorcore_avg")
+
+
+def test_prom_check_flags_unaddressable_series():
+    payload = prom_payload(
+        [{"metric": {"__name__": "m"}, "value": [0, "1"]}]
+    )
+    with pytest.raises(AssertionError, match="addressing"):
+        check_prom_vector(payload, "m")
+
+
+def test_api_check():
+    ok = json.dumps(
+        {"resources": [{"name": "deployments.apps/tpu_test_tensorcore_avg"}]}
+    )
+    assert "discoverable" in check_custom_metrics_api(ok, "tpu_test_tensorcore_avg")
+    with pytest.raises(AssertionError, match="discovery"):
+        check_custom_metrics_api(json.dumps({"resources": []}), "m")
+
+
+def test_hpa_check():
+    ok = json.dumps(
+        {
+            "status": {
+                "currentReplicas": 2,
+                "desiredReplicas": 4,
+                "conditions": [{"type": "ScalingActive", "status": "True"}],
+            }
+        }
+    )
+    assert "current=2 desired=4" in check_hpa_status(ok)
+    bad = json.dumps(
+        {
+            "status": {
+                "conditions": [
+                    {
+                        "type": "ScalingActive",
+                        "status": "False",
+                        "reason": "FailedGetObjectMetric",
+                        "message": "unable to get metric",
+                    }
+                ]
+            }
+        }
+    )
+    with pytest.raises(AssertionError, match="FailedGetObjectMetric"):
+        check_hpa_status(bad)
+
+
+# ---- orchestration ----------------------------------------------------------
+
+
+def test_diagnose_stops_at_first_broken_joint():
+    def down():
+        raise ConnectionError("connection refused")
+
+    called = []
+    results = diagnose(
+        exporter_fetch=down,
+        prom_fetch=lambda: called.append("prom") or "{}",
+    )
+    assert len(results) == 1  # never advanced past the failing L2 probe
+    assert not results[0].ok and "refused" in results[0].detail
+    assert called == []  # the L3 fetcher was never invoked
+
+
+def test_diagnose_skips_absent_fetchers():
+    results = diagnose(exporter_fetch=lambda: exposition())
+    assert [r.ok for r in results] == [True, True, True, True]
+    assert results[1].detail.startswith("skipped")
+
+
+def test_diagnose_against_live_native_exporter():
+    """End-to-end over real HTTP: the native C++ exporter serves /metrics and
+    the doctor's L2 probe passes against it."""
+    import urllib.request
+
+    daemon = ExporterDaemon(
+        StubSource(num_chips=4),
+        StaticAttributor({0: ("default", "tpu-test-a"), 1: ("default", "tpu-test-b")}),
+        node_name="doctor-node",
+        listen_addr="127.0.0.1",
+        port=0,
+    )
+    try:
+        daemon.step()
+
+        def fetch():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.port}/metrics", timeout=5
+            ) as r:
+                return r.read().decode()
+
+        results = diagnose(exporter_fetch=fetch)
+        assert results[0].ok, results[0].detail
+        assert "4 chips" in results[0].detail
+        assert "2 attributed" in results[0].detail
+    finally:
+        daemon.close()

@@ -158,6 +158,62 @@ def test_adapter_rules_cover_all_recorded_series():
         assert f'{target}!=""' in r["seriesQuery"]
 
 
+def test_grafana_dashboard_matches_generator_and_series_contracts():
+    """The dashboard ConfigMap is generated (single source of truth) and every
+    PromQL expression references only series this pipeline produces — the same
+    string-contract discipline as the rules (SURVEY.md §1)."""
+    import json
+    import re
+    import subprocess
+    import sys
+
+    result = subprocess.run(
+        [sys.executable, "tools/gen_grafana_dashboard.py", "--check"],
+        cwd=DEPLOY.parent,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+
+    doc = load("grafana-dashboard.yaml")
+    assert doc["metadata"]["labels"]["grafana_dashboard"] == "1"  # sidecar opt-in
+    dash = json.loads(doc["data"]["tpu-hpa-pipeline.json"])
+
+    from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
+
+    rule_doc = load("tpu-test-prometheusrule.yaml")
+    recorded = {
+        r["record"] for g in rule_doc["spec"]["groups"] for r in g["rules"]
+    }
+    known = (
+        set(CHIP_METRICS)
+        | recorded
+        | {
+            "tpu_metrics_exporter_up",  # exporter self-metric (cpp/exporter)
+            # kube-state-metrics series from the stack install
+            "kube_horizontalpodautoscaler_status_current_replicas",
+            "kube_horizontalpodautoscaler_status_desired_replicas",
+            "kube_pod_labels",
+        }
+    )
+    exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
+    assert exprs, "dashboard has no queries"
+    for expr in exprs:
+        names = {
+            tok
+            for tok in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr)
+            if tok.startswith(("tpu_", "kube_"))
+        }
+        assert names, f"no metric reference in {expr!r}"
+        assert names <= known, f"unknown series in {expr!r}: {names - known}"
+    # multi-series panels carry a legend (identity never color-alone)
+    for p in dash["panels"]:
+        if p["type"] == "timeseries":
+            multi = len(p["targets"]) > 1 or "{{" in p["targets"][0]["legendFormat"]
+            if multi:
+                assert p["options"]["legend"]["showLegend"] is True, p["title"]
+
+
 def test_new_rung_workload_contracts():
     """The v5e-8 and training rung workloads: slice-sized TPU allotments, the
     same app-label join-key discipline, and the loadgen entrypoints they run."""

@@ -322,6 +322,26 @@ def test_external_metric_average_value_divides_by_replicas():
     assert target.replicas == 3  # 30 per replica = on target; stable
 
 
+def test_external_metric_inherits_controller_namespace():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    db.append("queue_backlog", (("namespace", "prod"), ("queue", "q1")), 80.0)
+    adapter = CustomMetricsAdapter(
+        db, [], external_rules=[ExternalRule(series="queue_backlog")]
+    )
+    target = FakeTarget(replicas=1)
+    hpa = HPAController(
+        target=target,
+        metrics=[ExternalMetricSpec("queue_backlog", target_value=20.0)],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+        namespace="prod",  # spec namespace unset -> controller's wins
+    )
+    hpa.sync_once()
+    assert target.replicas == 4  # 80/20
+
+
 def test_external_spec_requires_exactly_one_target():
     import pytest
 

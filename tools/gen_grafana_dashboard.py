@@ -1,0 +1,287 @@
+"""Generate deploy/grafana-dashboard.yaml — the pipeline observability board.
+
+The reference installs Grafana (inside kube-prometheus-stack, README.md:61) but
+never configures a dashboard (SURVEY.md §5 flags this gap).  This rebuild ships
+one: a ConfigMap carrying a dashboard JSON that kube-prometheus-stack's Grafana
+sidecar auto-loads (label ``grafana_dashboard: "1"``).  Panels cover every layer
+joint: the recorded autoscale series vs its HPA target, HPA current/desired
+replicas, per-pod chip utilization and HBM usage (the same max-by the recording
+rules apply), the training rung's multi-metric signals, and exporter health.
+
+Chart conventions: Grafana's own design system (palette-classic categorical
+order, multi-tooltip crosshair, single y-axis per panel, legends for
+multi-series panels); threshold lines mark the shipped HPA targets so the
+scale-up moment is visually anchored.
+
+tests/test_manifests.py checks the manifest on disk matches this generator AND
+that every PromQL expression references only series this pipeline actually
+produces (the string-contract discipline of SURVEY.md §1).
+
+Usage: python tools/gen_grafana_dashboard.py [--check]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+HPA_TARGET_PERCENT = 40  # deploy/tpu-test-hpa.yaml target value
+HBM_TARGET_BYTES = 13 * 2**30  # deploy/tpu-test-hbm-hpa.yaml averageValue 13Gi
+
+
+def _target(expr: str, legend: str, refid: str) -> dict:
+    return {
+        "expr": expr,
+        "legendFormat": legend,
+        "refId": refid,
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+    }
+
+
+def _ts_panel(
+    pid: int,
+    title: str,
+    x: int,
+    y: int,
+    targets: list[dict],
+    desc: str,
+    unit: str | None = None,
+    threshold: float | None = None,
+    max_y: float | None = None,
+    legend: bool = True,
+) -> dict:
+    defaults: dict = {
+        "color": {"mode": "palette-classic"},
+        "custom": {
+            "lineWidth": 2,
+            "fillOpacity": 0,
+            "pointSize": 5,
+            "showPoints": "never",
+            "spanNulls": False,
+        },
+        "min": 0,
+    }
+    if unit:
+        defaults["unit"] = unit
+    if max_y is not None:
+        defaults["max"] = max_y
+    if threshold is not None:
+        defaults["custom"]["thresholdsStyle"] = {"mode": "line"}
+        defaults["thresholds"] = {
+            "mode": "absolute",
+            "steps": [
+                {"color": "transparent", "value": None},
+                {"color": "red", "value": threshold},
+            ],
+        }
+    return {
+        "id": pid,
+        "type": "timeseries",
+        "title": title,
+        "description": desc,
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "fieldConfig": {"defaults": defaults, "overrides": []},
+        "options": {
+            "legend": {
+                "displayMode": "list",
+                "placement": "bottom",
+                "showLegend": legend,
+            },
+            "tooltip": {"mode": "multi", "sort": "desc"},
+        },
+        "targets": targets,
+    }
+
+
+def build_dashboard() -> dict:
+    panels = [
+        _ts_panel(
+            1,
+            "Autoscale signal: tpu_test_tensorcore_avg vs HPA target",
+            0,
+            0,
+            [_target("tpu_test_tensorcore_avg", "avg tensorcore util", "A")],
+            "The recorded series the HPA consumes (L3 output); the red line "
+            f"is the HPA target value ({HPA_TARGET_PERCENT}).",
+            unit="percent",
+            threshold=HPA_TARGET_PERCENT,
+            max_y=100,
+            legend=False,  # single series: the title names it
+        ),
+        _ts_panel(
+            2,
+            "HPA replicas: current vs desired",
+            12,
+            0,
+            [
+                _target(
+                    'kube_horizontalpodautoscaler_status_current_replicas'
+                    '{horizontalpodautoscaler="tpu-test"}',
+                    "current",
+                    "A",
+                ),
+                _target(
+                    'kube_horizontalpodautoscaler_status_desired_replicas'
+                    '{horizontalpodautoscaler="tpu-test"}',
+                    "desired",
+                    "B",
+                ),
+            ],
+            "The control loop's output (L5).  Desired leading current by more "
+            "than pod-start latency indicates capacity starvation.",
+        ),
+        _ts_panel(
+            3,
+            "Per-pod tensorcore utilization (hottest chip)",
+            0,
+            8,
+            [_target('max by(pod) (tpu_tensorcore_utilization{pod!=""})', "{{pod}}", "A")],
+            "Each pod collapsed to its hottest chip — the same max-by the "
+            "recording rule applies.",
+            unit="percent",
+            max_y=100,
+        ),
+        _ts_panel(
+            4,
+            "Per-pod HBM usage (hottest chip)",
+            12,
+            8,
+            [
+                _target(
+                    'max by(pod) (tpu_hbm_memory_usage_bytes{pod!=""})',
+                    "{{pod}}",
+                    "A",
+                ),
+                _target("min(tpu_hbm_memory_total_bytes)", "HBM capacity", "B"),
+            ],
+            "Drives the v5e-8 rung's Pods-metric HPA; the red line is its "
+            "13Gi AverageValue target.",
+            unit="bytes",
+            threshold=HBM_TARGET_BYTES,
+        ),
+        _ts_panel(
+            5,
+            "Training rung signals (multi-metric HPA)",
+            0,
+            16,
+            [
+                _target("tpu_train_duty_cycle_avg", "duty cycle avg", "A"),
+                _target("tpu_train_hbm_bw_avg", "HBM bandwidth util avg", "B"),
+            ],
+            "The two Object metrics of the tpu-train HPA; the controller "
+            "scales on the larger proposal.",
+            unit="percent",
+            max_y=100,
+        ),
+        {
+            # status palette reserved for state; explicit UP/DOWN text so the
+            # state is never color-alone
+            "id": 6,
+            "type": "stat",
+            "title": "Exporters up",
+            "description": "min over nodes of tpu_metrics_exporter_up — 1 "
+            "means every node exporter served fresh samples within its "
+            "staleness window.",
+            "gridPos": {"h": 8, "w": 12, "x": 12, "y": 16},
+            "datasource": {"type": "prometheus", "uid": "${datasource}"},
+            "fieldConfig": {
+                "defaults": {
+                    "mappings": [
+                        {
+                            "type": "value",
+                            "options": {"1": {"text": "UP", "color": "green"}},
+                        },
+                        {
+                            "type": "value",
+                            "options": {"0": {"text": "DOWN", "color": "red"}},
+                        },
+                    ],
+                    "thresholds": {
+                        "mode": "absolute",
+                        "steps": [
+                            {"color": "red", "value": None},
+                            {"color": "green", "value": 1},
+                        ],
+                    },
+                },
+                "overrides": [],
+            },
+            "options": {
+                "colorMode": "background",
+                "graphMode": "area",
+                "reduceOptions": {"calcs": ["lastNotNull"]},
+                "textMode": "value_and_name",
+            },
+            "targets": [_target("min(tpu_metrics_exporter_up)", "exporters up", "A")],
+        },
+    ]
+    return {
+        "title": "TPU HPA pipeline",
+        "uid": "tpu-hpa-pipeline",
+        "tags": ["tpu", "autoscaling"],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "5s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                    "label": "Data source",
+                    "current": {},
+                }
+            ]
+        },
+        "panels": panels,
+    }
+
+
+HEADER = """\
+# Grafana dashboard for the whole pipeline, auto-loaded by the
+# kube-prometheus-stack Grafana sidecar (label grafana_dashboard: "1").
+# The reference installs Grafana but ships no dashboard (SURVEY.md: aux
+# subsystems); this closes that gap with one panel per layer joint.
+#
+# GENERATED by tools/gen_grafana_dashboard.py; tests/test_manifests.py checks
+# this file matches the generator and that every query references series the
+# pipeline actually produces.
+"""
+
+
+def render() -> str:
+    dashboard_json = json.dumps(build_dashboard(), indent=1)
+    indented = "\n".join("    " + line for line in dashboard_json.splitlines())
+    return (
+        HEADER
+        + "apiVersion: v1\n"
+        + "kind: ConfigMap\n"
+        + "metadata:\n"
+        + "  name: tpu-hpa-dashboard\n"
+        + "  labels:\n"
+        + '    grafana_dashboard: "1"\n'
+        + "data:\n"
+        + "  tpu-hpa-pipeline.json: |\n"
+        + indented
+        + "\n"
+    )
+
+
+def main() -> None:
+    target = Path(__file__).resolve().parent.parent / "deploy/grafana-dashboard.yaml"
+    content = render()
+    if "--check" in sys.argv:
+        if target.read_text() != content:
+            print(f"{target} is stale; rerun tools/gen_grafana_dashboard.py")
+            sys.exit(1)
+        print("up to date")
+        return
+    target.write_text(content)
+    print(f"wrote {target}")
+
+
+if __name__ == "__main__":
+    main()
