@@ -1,0 +1,171 @@
+"""The framework's CLI umbrella: ``python -m k8s_gpu_hpa_tpu <command>``.
+
+The reference's only entry point is a README of eleven copy-paste steps
+(README.md:15-123, SURVEY.md §1 "CLI/operator layer").  This CLI makes each
+runtime role and operator task a named command:
+
+    doctor        run the runbook's probes in order, stop at the broken joint
+    exporter      the L2 metrics exporter daemon (DaemonSet container cmd)
+    loadgen       the L1 matmul load generator (tpu-test container cmd)
+    train         the ResNet-50 training workload (tpu-train container cmd)
+    multihost     the multi-host SPMD load generator (StatefulSet container cmd)
+    stub-libtpu   a fake libtpu metrics server on :8431 for hardware-free runs
+    gen-pipeline  render a complete custom pipeline (deployment/rule/adapter/HPA)
+    gen-manifests check or write the generated shipped manifests
+
+Container commands stay reachable at their module paths too
+(``python -m k8s_gpu_hpa_tpu.exporter`` etc. — the forms the shipped
+manifests invoke); this umbrella adds discoverability and the gen-* tools.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def _cmd_gen_pipeline(args: argparse.Namespace) -> int:
+    from k8s_gpu_hpa_tpu import manifests
+    from k8s_gpu_hpa_tpu.metrics import schema
+
+    metric = {
+        "tensorcore": schema.TPU_TENSORCORE_UTIL,
+        "duty-cycle": schema.TPU_DUTY_CYCLE,
+        "hbm-bw": schema.TPU_HBM_BW_UTIL,
+    }[args.metric]
+    spec = manifests.PipelineSpec(
+        app=args.app,
+        device_metric=metric,
+        target=args.target,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        tpu_limit=args.tpu_limit,
+        topology=args.topology,
+        accelerator=args.accelerator,
+        namespace=args.namespace,
+    )
+    files = manifests.render_pipeline(spec)
+    if args.out:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        for name, docs in files.items():
+            (out / name).write_text(manifests.to_yaml(docs))
+            print(f"wrote {out / name}")
+    else:
+        for i, (name, docs) in enumerate(files.items()):
+            if i:
+                print("---")
+            print(f"# ===== {name} =====")
+            print(manifests.to_yaml(docs))
+    return 0
+
+
+def _cmd_gen_manifests(args: argparse.Namespace) -> int:
+    import yaml
+
+    from k8s_gpu_hpa_tpu import manifests
+
+    bundle = manifests.default_bundle()
+    deploy = Path(__file__).resolve().parent.parent / "deploy"
+    if args.check:
+        stale = []
+        for name, docs in bundle.items():
+            path = deploy / name
+            if not path.exists():
+                stale.append(f"{name} (missing)")
+            elif list(yaml.safe_load_all(path.read_text())) != docs:
+                stale.append(name)
+        if stale:
+            print("stale (disagree with manifests.py): " + ", ".join(sorted(stale)))
+            return 1
+        print(f"all {len(bundle)} manifests agree with the generator")
+        return 0
+    out = Path(args.out or deploy)
+    out.mkdir(parents=True, exist_ok=True)
+    for name, docs in bundle.items():
+        (out / name).write_text(manifests.to_yaml(docs))
+        print(f"wrote {out / name}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m k8s_gpu_hpa_tpu", description=__doc__.split("\n\n")[0]
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("doctor", help="probe every pipeline joint in order")
+    sub.add_parser("exporter", help="run the L2 metrics exporter daemon")
+    sub.add_parser("loadgen", help="run the L1 matmul load generator")
+    sub.add_parser("train", help="run the ResNet-50 training workload")
+    sub.add_parser("multihost", help="run the multi-host SPMD load generator")
+    sub.add_parser("stub-libtpu", help="run a fake libtpu metrics server")
+
+    gen = sub.add_parser(
+        "gen-pipeline", help="render a complete custom autoscaling pipeline"
+    )
+    gen.add_argument("--app", required=True, help="app name (the pipeline join key)")
+    gen.add_argument(
+        "--metric",
+        choices=["tensorcore", "duty-cycle", "hbm-bw"],
+        default="tensorcore",
+        help="device metric to autoscale on",
+    )
+    gen.add_argument("--target", default="40", help="HPA target value")
+    gen.add_argument("--min-replicas", type=int, default=1)
+    gen.add_argument("--max-replicas", type=int, default=4)
+    gen.add_argument("--tpu-limit", type=int, default=1, help="chips per pod")
+    gen.add_argument("--topology", default="1x1")
+    gen.add_argument("--accelerator", default="tpu-v5-lite-podslice")
+    gen.add_argument("--namespace", default="default")
+    gen.add_argument("-o", "--out", help="directory to write files (default: stdout)")
+
+    genm = sub.add_parser(
+        "gen-manifests", help="check or write the generated shipped manifests"
+    )
+    genm.add_argument(
+        "--check", action="store_true", help="verify deploy/ agrees with the generator"
+    )
+    genm.add_argument("-o", "--out", help="directory to write to (default: deploy/)")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "doctor":
+        from k8s_gpu_hpa_tpu.doctor import main as doctor_main
+
+        return doctor_main()
+    if args.command == "exporter":
+        from k8s_gpu_hpa_tpu.exporter.daemon import main as exporter_main
+
+        exporter_main()
+        return 0
+    if args.command == "loadgen":
+        from k8s_gpu_hpa_tpu.loadgen.matmul import main as loadgen_main
+
+        loadgen_main()
+        return 0
+    if args.command == "train":
+        from k8s_gpu_hpa_tpu.loadgen.train import main as train_main
+
+        train_main()
+        return 0
+    if args.command == "multihost":
+        from k8s_gpu_hpa_tpu.loadgen.multihost import main as multihost_main
+
+        multihost_main()
+        return 0
+    if args.command == "stub-libtpu":
+        from k8s_gpu_hpa_tpu.exporter.stub_libtpu import main as stub_main
+
+        stub_main()
+        return 0
+    if args.command == "gen-pipeline":
+        return _cmd_gen_pipeline(args)
+    if args.command == "gen-manifests":
+        return _cmd_gen_manifests(args)
+    raise AssertionError(f"unhandled command {args.command}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

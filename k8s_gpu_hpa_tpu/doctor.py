@@ -19,7 +19,7 @@ Two frontends share the probe definitions:
 
 Env for the CLI: EXPORTER_URL (default http://localhost:9400/metrics),
 PROM_URL (default http://localhost:9090), METRIC (default
-tpu_test_tensorcore_avg), DEPLOYMENT / NAMESPACE for the HPA check.
+tpu_test_tensorcore_avg), HPA / NAMESPACE for the HPA check.
 """
 
 from __future__ import annotations

@@ -16,6 +16,7 @@ the steady loop.
 from __future__ import annotations
 
 import os
+import signal
 import time
 from dataclasses import dataclass
 
@@ -243,9 +244,27 @@ def main() -> None:
         f"intensity={knob.value} (knob: {knob.file})",
         flush=True,
     )
+    # HPA scale-down delivers SIGTERM with a grace period (default 30 s) —
+    # plenty for one final synchronous save, which makes downscaling actually
+    # loss-free instead of losing up to CHECKPOINT_EVERY steps of work.
+    stopping = False
+
+    def _terminate(signum, frame):
+        nonlocal stopping
+        stopping = True
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
     last_report = time.perf_counter()
     last_ckpt_step = gen.stats().steps
     while True:
+        if stopping:
+            if manager is not None and gen.stats().steps > last_ckpt_step:
+                gen.save_checkpoint(manager)
+                manager.wait_until_finished()  # flush the async commit
+                print(f"final checkpoint at step {gen.stats().steps}", flush=True)
+            return
         if knob.poll() <= 0.0:
             knob.throttle(0.0)
         else:

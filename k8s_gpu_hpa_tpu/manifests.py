@@ -1,0 +1,819 @@
+"""Manifest generation: one source of truth for the pipeline's string contracts.
+
+SURVEY.md §1's key observation about the reference is that its five layers are
+joined only by string contracts — a pod label, a metric name, a port name, a
+release label — duplicated by hand across files, so that breaking any single
+string silently breaks the loop (the reference even instructs hand-editing
+manifests, README.md:39).  This module removes the duplication: every shipped
+manifest in ``deploy/`` is expressible as a function of the constants below,
+and ``tests/test_gen_manifests.py`` asserts the YAML on disk is semantically
+identical to what these builders produce.  Change a contract here and the test
+points at every stale file; change a file by hand and the test points here.
+
+It also generalizes the pipeline: ``PipelineSpec`` renders a complete
+workload + recording-rule + adapter-rule + HPA set for *any* app name, device
+metric, and target — the reference's single hard-wired `cuda-test` pipeline
+becomes a parameterized product (``python -m k8s_gpu_hpa_tpu gen-pipeline``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    RecordingRule,
+    tpu_test_avg_rule,
+    tpu_test_multihost_avg_rule,
+    tpu_test_pod_max_rule,
+)
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    TPU_DUTY_CYCLE,
+    TPU_HBM_BW_UTIL,
+    TPU_HBM_USAGE,
+    TPU_TENSORCORE_UTIL,
+)
+
+# ---------------------------------------------------------------------------
+# The string contracts (each cited to the shipped manifest that carries it).
+
+EXPORTER_NAME = "tpu-metrics-exporter"  # DaemonSet/Service/scrape relabel key
+EXPORTER_PORT = 9400  # same port contract as dcgm-exporter (dcgm-exporter.yaml:31)
+EXPORTER_PORT_NAME = "metrics"  # the *name* the scrape config binds to
+EXPORTER_IMAGE = "ghcr.io/k8s-tpu-hpa/tpu-metrics-exporter:0.1.0"
+WORKLOAD_IMAGE = "ghcr.io/k8s-tpu-hpa/tpu-test:0.1.0"
+VERSION = "0.1.0"
+
+SCRAPE_JOB = "tpu-metrics"
+SCRAPE_INTERVAL = "1s"  # reference parity (kube-prometheus-stack-values.yaml:5)
+RULE_INTERVAL = "1s"  # not Prometheus' default 30s: freshness bounds the loop
+RELEASE_LABEL = "kube-prometheus-stack"  # the operator's rule-selector trap
+PROMETHEUS_URL = "http://kube-prometheus-stack-prometheus.default.svc.cluster.local"
+
+TPU_RESOURCE = "google.com/tpu"  # analog of nvidia.com/gpu
+ACCEL_V5E = "tpu-v5-lite-podslice"
+ACCEL_V5P = "tpu-v5p-slice"
+NODE_SELECTOR_ACCEL = "cloud.google.com/gke-tpu-accelerator"
+NODE_SELECTOR_TOPO = "cloud.google.com/gke-tpu-topology"
+
+INTENSITY_FILE = "/tmp/tpu-test-intensity"  # the runtime load knob
+COORDINATOR_PORT = 8476  # jax.distributed coordinator (multihost rung)
+
+#: device metric -> short stem used in recorded-series names
+METRIC_STEMS = {
+    TPU_TENSORCORE_UTIL: "tensorcore",
+    TPU_DUTY_CYCLE: "duty_cycle",
+    TPU_HBM_BW_UTIL: "hbm_bw",
+    TPU_HBM_USAGE: "hbm_used_bytes",
+}
+
+
+def tpu_tolerations() -> list[dict]:
+    return [{"key": TPU_RESOURCE, "operator": "Exists", "effect": "NoSchedule"}]
+
+
+def default_behavior(
+    *,
+    up_pods: int = 2,
+    up_percent: int | None = 100,
+    down_window: int = 120,
+    down_percent: int = 50,
+) -> dict:
+    """The behavior stanza every shipped HPA carries — the fix for the
+    reference's documented overshoot defect (README.md:123): bounded scale-up
+    steps, a scale-down stabilization window.  The defaults still clear the
+    north-star budget (1→4 within 60 s at 2 pods per 15 s sync)."""
+    up_policies: list[dict] = [{"type": "Pods", "value": up_pods, "periodSeconds": 15}]
+    if up_percent is not None:
+        up_policies.append(
+            {"type": "Percent", "value": up_percent, "periodSeconds": 15}
+        )
+    return {
+        "scaleUp": {
+            "stabilizationWindowSeconds": 0,
+            "selectPolicy": "Max",
+            "policies": up_policies,
+        },
+        "scaleDown": {
+            "stabilizationWindowSeconds": down_window,
+            "selectPolicy": "Max",
+            "policies": [
+                {"type": "Percent", "value": down_percent, "periodSeconds": 60}
+            ],
+        },
+    }
+
+
+def object_metric(name: str, kind: str, target_name: str, value: str) -> dict:
+    """One Object-type HPA metric entry (the reference's only metric shape,
+    cuda-test-hpa.yaml:13-21, upgraded to autoscaling/v2)."""
+    return {
+        "type": "Object",
+        "object": {
+            "metric": {"name": name},
+            "describedObject": {
+                "apiVersion": "apps/v1",
+                "kind": kind,
+                "name": target_name,
+            },
+            "target": {"type": "Value", "value": value},
+        },
+    }
+
+
+def hpa_manifest(
+    name: str,
+    *,
+    target_kind: str = "Deployment",
+    target_name: str | None = None,
+    metrics: list[dict],
+    min_replicas: int = 1,
+    max_replicas: int = 4,
+    behavior: dict | None = None,
+    annotations: dict[str, str] | None = None,
+) -> dict:
+    doc: dict = {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": name},
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": "apps/v1",
+                "kind": target_kind,
+                "name": target_name or name,
+            },
+            "minReplicas": min_replicas,
+            "maxReplicas": max_replicas,
+            "metrics": metrics,
+            "behavior": behavior if behavior is not None else default_behavior(),
+        },
+    }
+    if annotations:
+        doc["metadata"]["annotations"] = annotations
+    return doc
+
+
+def workload_deployment(
+    name: str,
+    *,
+    command: list[str],
+    env: dict[str, str],
+    tpu_limit: int,
+    topology: str,
+    accelerator: str = ACCEL_V5E,
+    container_name: str | None = None,
+) -> dict:
+    """A TPU workload Deployment (analog of cuda-test-deployment.yaml): the
+    ``app: <name>`` label is the pipeline join key, ``spec.replicas`` is
+    deliberately absent so the HPA takes ownership (reference parity), and the
+    intensity-file env gives the runtime load knob that replaces the
+    reference's "rerun the busy-loop via exec" trick (README.md:113-116)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "labels": {"app": name}},
+        "spec": {
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "nodeSelector": {
+                        NODE_SELECTOR_ACCEL: accelerator,
+                        NODE_SELECTOR_TOPO: topology,
+                    },
+                    "tolerations": tpu_tolerations(),
+                    "containers": [
+                        {
+                            "name": container_name or name,
+                            "image": WORKLOAD_IMAGE,
+                            "command": command,
+                            "env": [
+                                {"name": k, "value": v} for k, v in env.items()
+                            ],
+                            "resources": {"limits": {TPU_RESOURCE: tpu_limit}},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def loadgen_env(intensity: str = "0.5", matmul_size: str | None = "4096") -> dict[str, str]:
+    env: dict[str, str] = {}
+    if matmul_size is not None:
+        env["MATMUL_SIZE"] = matmul_size
+    env["TPU_TEST_INTENSITY"] = intensity
+    env["TPU_TEST_INTENSITY_FILE"] = INTENSITY_FILE
+    return env
+
+
+# ---------------------------------------------------------------------------
+# L2: the exporter DaemonSet + Service (analog dcgm-exporter.yaml:1-77).
+
+
+def exporter_daemonset(accelerator: str = ACCEL_V5E) -> dict:
+    labels = {
+        "app.kubernetes.io/name": EXPORTER_NAME,
+        "app.kubernetes.io/version": VERSION,
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": EXPORTER_NAME, "labels": dict(labels)},
+        "spec": {
+            "updateStrategy": {"type": "RollingUpdate"},
+            "selector": {
+                "matchLabels": {"app.kubernetes.io/name": EXPORTER_NAME}
+            },
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "nodeSelector": {NODE_SELECTOR_ACCEL: accelerator},
+                    "tolerations": tpu_tolerations(),
+                    "hostNetwork": True,
+                    "containers": [
+                        {
+                            "name": "exporter",
+                            "image": EXPORTER_IMAGE,
+                            "command": ["python", "-m", "k8s_gpu_hpa_tpu.exporter"],
+                            "env": [
+                                {"name": "SOURCE", "value": "libtpu"},
+                                {"name": "LISTEN_PORT", "value": str(EXPORTER_PORT)},
+                                {"name": "COLLECT_MS", "value": "1000"},
+                                {
+                                    "name": "NODE_NAME",
+                                    "valueFrom": {
+                                        "fieldRef": {"fieldPath": "spec.nodeName"}
+                                    },
+                                },
+                            ],
+                            "ports": [
+                                {
+                                    "name": EXPORTER_PORT_NAME,
+                                    "containerPort": EXPORTER_PORT,
+                                }
+                            ],
+                            "volumeMounts": [
+                                {
+                                    "name": "pod-resources",
+                                    "mountPath": "/var/lib/kubelet/pod-resources",
+                                    "readOnly": True,
+                                }
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "pod-resources",
+                            "hostPath": {"path": "/var/lib/kubelet/pod-resources"},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def exporter_service() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": EXPORTER_NAME,
+            "labels": {"app.kubernetes.io/name": EXPORTER_NAME},
+        },
+        "spec": {
+            "selector": {"app.kubernetes.io/name": EXPORTER_NAME},
+            "ports": [{"name": EXPORTER_PORT_NAME, "port": EXPORTER_PORT}],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# L3: Prometheus stack values + PrometheusRule.
+
+
+def prom_stack_values() -> dict:
+    """Helm values for kube-prometheus-stack (reused as-is, SURVEY.md §2b):
+    the 1 s ``tpu-metrics`` scrape job with the reference's node relabel
+    (kube-prometheus-stack-values.yaml:13-16) plus keep-filters pinning the
+    job to the exporter Service's named port."""
+    return {
+        "prometheus": {
+            "prometheusSpec": {
+                "additionalScrapeConfigs": [
+                    {
+                        "job_name": SCRAPE_JOB,
+                        "scrape_interval": SCRAPE_INTERVAL,
+                        "metrics_path": "/metrics",
+                        "kubernetes_sd_configs": [
+                            {"role": "endpoints", "namespaces": {"names": ["default"]}}
+                        ],
+                        "relabel_configs": [
+                            {
+                                "source_labels": ["__meta_kubernetes_service_name"],
+                                "regex": EXPORTER_NAME,
+                                "action": "keep",
+                            },
+                            {
+                                "source_labels": [
+                                    "__meta_kubernetes_endpoint_port_name"
+                                ],
+                                "regex": EXPORTER_PORT_NAME,
+                                "action": "keep",
+                            },
+                            {
+                                "source_labels": ["__meta_kubernetes_pod_node_name"],
+                                "separator": ";",
+                                "regex": "^(.*)$",
+                                "target_label": "node",
+                                "replacement": "$1",
+                                "action": "replace",
+                            },
+                        ],
+                    }
+                ]
+            }
+        }
+    }
+
+
+def _rule_entry(rule: RecordingRule) -> dict:
+    entry: dict = {"record": rule.record, "expr": rule.expr.promql()}
+    if rule.labels:
+        entry["labels"] = dict(rule.labels)
+    return entry
+
+
+def shipped_rule_groups() -> list[tuple[str, list[RecordingRule]]]:
+    """Every recording rule the shipped pipeline evaluates, grouped as in
+    deploy/tpu-test-prometheusrule.yaml — built from the same tested ASTs the
+    closed-loop harness executes (metrics/rules.py)."""
+    return [
+        (
+            "tpu-test",
+            [
+                tpu_test_avg_rule(),
+                tpu_test_avg_rule(
+                    metric=TPU_DUTY_CYCLE, record="tpu_test_duty_cycle_avg"
+                ),
+                tpu_test_avg_rule(
+                    metric=TPU_HBM_BW_UTIL, record="tpu_test_hbm_bw_avg"
+                ),
+            ],
+        ),
+        (
+            "tpu-test-v5e8",
+            [
+                tpu_test_pod_max_rule(
+                    app="tpu-test-v5e8", record="tpu_test_hbm_used_bytes"
+                )
+            ],
+        ),
+        (
+            "tpu-train",
+            [
+                tpu_test_avg_rule(
+                    app="tpu-train",
+                    deployment="tpu-train",
+                    metric=TPU_DUTY_CYCLE,
+                    record="tpu_train_duty_cycle_avg",
+                ),
+                tpu_test_avg_rule(
+                    app="tpu-train",
+                    deployment="tpu-train",
+                    metric=TPU_HBM_BW_UTIL,
+                    record="tpu_train_hbm_bw_avg",
+                ),
+            ],
+        ),
+        ("tpu-test-multihost", [tpu_test_multihost_avg_rule()]),
+    ]
+
+
+def prometheusrule_manifest(
+    name: str = "tpu-test",
+    groups: list[tuple[str, list[RecordingRule]]] | None = None,
+) -> dict:
+    return {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {"name": name, "labels": {"release": RELEASE_LABEL}},
+        "spec": {
+            "groups": [
+                {
+                    "name": group_name,
+                    "interval": RULE_INTERVAL,
+                    "rules": [_rule_entry(r) for r in rules],
+                }
+                for group_name, rules in (groups or shipped_rule_groups())
+            ]
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# L4: prometheus-adapter values (explicit rules, not default discovery).
+
+
+def adapter_rule(series: str, resource: str = "deployment") -> dict:
+    """One explicit seriesQuery rule: expose ``series`` addressed by its
+    ``namespace`` + object labels (the association trick of
+    cuda-test-prometheusrule.yaml:14-16, made explicit instead of relying on
+    the adapter's default discovery, README.md:91-95)."""
+    return {
+        "seriesQuery": f'{series}{{namespace!="",{resource}!=""}}',
+        "resources": {
+            "overrides": {
+                "namespace": {"resource": "namespace"},
+                resource: {"resource": resource},
+            }
+        },
+        "name": {"as": series},
+        "metricsQuery": "max by (<<.GroupBy>>) (<<.Series>>{<<.LabelMatchers>>})",
+    }
+
+
+def adapter_values(rules: list[dict] | None = None) -> dict:
+    if rules is None:
+        rules = [
+            adapter_rule("tpu_test_tensorcore_avg"),
+            adapter_rule("tpu_test_duty_cycle_avg"),
+            adapter_rule("tpu_test_hbm_bw_avg"),
+            adapter_rule("tpu_test_hbm_used_bytes", resource="pod"),
+            adapter_rule("tpu_train_duty_cycle_avg"),
+            adapter_rule("tpu_train_hbm_bw_avg"),
+            adapter_rule("tpu_test_multihost_tensorcore_avg", resource="statefulset"),
+        ]
+    return {
+        "prometheus": {"url": PROMETHEUS_URL, "port": 9090},
+        "rules": {"default": False, "custom": rules},
+    }
+
+
+# ---------------------------------------------------------------------------
+# The shipped bundle: every deploy/ manifest, semantically.
+
+
+def _tpu_test_deployment() -> dict:
+    return workload_deployment(
+        "tpu-test",
+        command=["python", "-m", "k8s_gpu_hpa_tpu.loadgen"],
+        env=loadgen_env(),
+        tpu_limit=1,
+        topology="1x1",
+    )
+
+
+def _tpu_test_v5e8_deployment() -> dict:
+    return workload_deployment(
+        "tpu-test-v5e8",
+        command=["python", "-m", "k8s_gpu_hpa_tpu.loadgen"],
+        env=loadgen_env(matmul_size="8192"),
+        tpu_limit=8,
+        topology="2x4",
+        container_name="tpu-test",
+    )
+
+
+def _tpu_train_deployment() -> dict:
+    return workload_deployment(
+        "tpu-train",
+        command=["python", "-m", "k8s_gpu_hpa_tpu.loadgen.train"],
+        env={
+            "BATCH_SIZE": "256",
+            "IMAGE_SIZE": "32",
+            "TPU_TEST_INTENSITY": "1.0",
+            "TPU_TEST_INTENSITY_FILE": INTENSITY_FILE,
+        },
+        tpu_limit=4,
+        topology="2x2",
+    )
+
+
+def _multihost_service() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "tpu-test-multihost",
+            "labels": {"app": "tpu-test-multihost"},
+        },
+        "spec": {
+            # the literal string "None" is the k8s headless-service sentinel;
+            # a YAML null here would be rejected by the apiserver
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,
+            "selector": {"app": "tpu-test-multihost"},
+            "ports": [{"name": "coordinator", "port": COORDINATOR_PORT}],
+        },
+    }
+
+
+def _multihost_statefulset() -> dict:
+    name = "tpu-test-multihost"
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "labels": {"app": name}},
+        "spec": {
+            "serviceName": name,
+            "podManagementPolicy": "Parallel",
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "nodeSelector": {
+                        NODE_SELECTOR_ACCEL: ACCEL_V5P,
+                        NODE_SELECTOR_TOPO: "2x2x2",
+                    },
+                    "tolerations": tpu_tolerations(),
+                    "containers": [
+                        {
+                            "name": "tpu-test",
+                            "image": WORKLOAD_IMAGE,
+                            "command": [
+                                "python",
+                                "-m",
+                                "k8s_gpu_hpa_tpu.loadgen.multihost",
+                            ],
+                            "env": [
+                                {"name": "HOSTS_PER_SLICE", "value": "2"},
+                                {"name": "HEADLESS_SERVICE", "value": name},
+                                {
+                                    "name": "POD_NAMESPACE",
+                                    "valueFrom": {
+                                        "fieldRef": {
+                                            "fieldPath": "metadata.namespace"
+                                        }
+                                    },
+                                },
+                                {"name": "BUFFER_MB", "value": "64"},
+                                {"name": "TPU_TEST_INTENSITY", "value": "0.5"},
+                                {
+                                    "name": "TPU_TEST_INTENSITY_FILE",
+                                    "value": INTENSITY_FILE,
+                                },
+                            ],
+                            "ports": [
+                                {
+                                    "name": "coordinator",
+                                    "containerPort": COORDINATOR_PORT,
+                                }
+                            ],
+                            "resources": {"limits": {TPU_RESOURCE: 4}},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def _cpu_busyloop() -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "cpu-busyloop", "labels": {"app": "cpu-busyloop"}},
+        "spec": {
+            "selector": {"matchLabels": {"app": "cpu-busyloop"}},
+            "template": {
+                "metadata": {"labels": {"app": "cpu-busyloop"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "busyloop",
+                            "image": "busybox:1.36",
+                            "command": ["sh", "-c", "while :; do :; done"],
+                            "resources": {
+                                "requests": {"cpu": "500m"},
+                                "limits": {"cpu": "1"},
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+def default_bundle() -> dict[str, list[dict]]:
+    """filename -> document list for every contract-bearing shipped manifest.
+
+    (deploy/grafana-dashboard.yaml is covered by its own generator,
+    tools/gen_grafana_dashboard.py, and excluded here.)
+    """
+    return {
+        "tpu-metrics-exporter.yaml": [exporter_daemonset(), exporter_service()],
+        "kube-prometheus-stack-values.yaml": [prom_stack_values()],
+        "prometheus-adapter-values.yaml": [adapter_values()],
+        "tpu-test-prometheusrule.yaml": [prometheusrule_manifest()],
+        "tpu-test-deployment.yaml": [_tpu_test_deployment()],
+        "tpu-test-hpa.yaml": [
+            hpa_manifest(
+                "tpu-test",
+                metrics=[
+                    object_metric(
+                        "tpu_test_tensorcore_avg", "Deployment", "tpu-test", "40"
+                    )
+                ],
+            )
+        ],
+        "tpu-test-v5e8-deployment.yaml": [_tpu_test_v5e8_deployment()],
+        "tpu-test-hbm-hpa.yaml": [
+            hpa_manifest(
+                "tpu-test-v5e8",
+                metrics=[
+                    {
+                        "type": "Pods",
+                        "pods": {
+                            "metric": {"name": "tpu_test_hbm_used_bytes"},
+                            "target": {
+                                "type": "AverageValue",
+                                "averageValue": "13Gi",
+                            },
+                        },
+                    }
+                ],
+            )
+        ],
+        "tpu-train-deployment.yaml": [_tpu_train_deployment()],
+        "tpu-train-hpa.yaml": [
+            hpa_manifest(
+                "tpu-train",
+                metrics=[
+                    object_metric(
+                        "tpu_train_duty_cycle_avg", "Deployment", "tpu-train", "50"
+                    ),
+                    object_metric(
+                        "tpu_train_hbm_bw_avg", "Deployment", "tpu-train", "30"
+                    ),
+                ],
+            )
+        ],
+        "tpu-test-multihost.yaml": [_multihost_service(), _multihost_statefulset()],
+        "tpu-test-multihost-hpa.yaml": [
+            hpa_manifest(
+                "tpu-test-multihost",
+                target_kind="StatefulSet",
+                metrics=[
+                    object_metric(
+                        "tpu_test_multihost_tensorcore_avg",
+                        "StatefulSet",
+                        "tpu-test-multihost",
+                        "40",
+                    )
+                ],
+                min_replicas=2,
+                max_replicas=8,
+                annotations={"k8s-tpu-hpa/replica-quantum": "2"},
+                behavior={
+                    "scaleUp": {
+                        "stabilizationWindowSeconds": 0,
+                        "selectPolicy": "Max",
+                        "policies": [
+                            {"type": "Pods", "value": 4, "periodSeconds": 15}
+                        ],
+                    },
+                    "scaleDown": {
+                        "stabilizationWindowSeconds": 120,
+                        "selectPolicy": "Max",
+                        "policies": [
+                            {"type": "Pods", "value": 2, "periodSeconds": 60}
+                        ],
+                    },
+                },
+            )
+        ],
+        "cpu-busyloop.yaml": [_cpu_busyloop()],
+        "cpu-busyloop-hpa.yaml": [
+            hpa_manifest(
+                "cpu-busyloop",
+                metrics=[
+                    {
+                        "type": "Resource",
+                        "resource": {
+                            "name": "cpu",
+                            "target": {
+                                "type": "Utilization",
+                                "averageUtilization": 60,
+                            },
+                        },
+                    }
+                ],
+                behavior=default_behavior(up_percent=None),
+            )
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Parameterized pipelines: the whole vertical stack for any app.
+
+
+@dataclass
+class PipelineSpec:
+    """A complete custom autoscaling pipeline for one TPU workload.
+
+    The reference hard-wires exactly one pipeline (app `cuda-test`, metric
+    `dcgm_gpu_utilization`, target 5).  A spec renders all four app-specific
+    artifacts — workload Deployment, recording rule, adapter rule, HPA — with
+    every string contract derived from ``app`` once, so they cannot drift.
+    """
+
+    app: str
+    device_metric: str = TPU_TENSORCORE_UTIL
+    target: str = "40"
+    min_replicas: int = 1
+    max_replicas: int = 4
+    tpu_limit: int = 1
+    topology: str = "1x1"
+    accelerator: str = ACCEL_V5E
+    namespace: str = "default"
+    intensity: str = "0.5"
+    command: list[str] = field(
+        default_factory=lambda: ["python", "-m", "k8s_gpu_hpa_tpu.loadgen"]
+    )
+
+    def __post_init__(self) -> None:
+        import re
+
+        # RFC 1123 label: what every derived contract must survive — the
+        # Deployment/HPA names and the app label (apiserver validation), and
+        # via '-'→'_' the recorded series name (Prometheus metric charset).
+        # Rejecting here is the whole point of the generator: a bad string
+        # caught at render time, not at apply time.
+        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?", self.app):
+            raise ValueError(
+                f"app {self.app!r} is not a DNS-1123 label (lowercase "
+                "alphanumerics and '-', at most 63 chars, alphanumeric ends)"
+            )
+        if self.device_metric not in METRIC_STEMS:
+            raise ValueError(
+                f"unknown device metric {self.device_metric!r}; "
+                f"one of {sorted(METRIC_STEMS)}"
+            )
+
+    @property
+    def record(self) -> str:
+        """The recorded series name, derived from the app name the same way
+        the reference derives cuda_test_gpu_avg from cuda-test."""
+        stem = METRIC_STEMS[self.device_metric]
+        return f"{self.app.replace('-', '_')}_{stem}_avg"
+
+    def recording_rule(self) -> RecordingRule:
+        return tpu_test_avg_rule(
+            app=self.app,
+            deployment=self.app,
+            namespace=self.namespace,
+            metric=self.device_metric,
+            record=self.record,
+        )
+
+
+def render_pipeline(spec: PipelineSpec) -> dict[str, list[dict]]:
+    """filename -> docs for the four app-specific artifacts of one pipeline.
+
+    The shared layers (exporter DaemonSet, Prometheus stack values) are
+    app-independent and come from ``default_bundle()``; the adapter values
+    here carry only this pipeline's rule — merge into an existing adapter
+    config when running several pipelines side by side."""
+    return {
+        f"{spec.app}-deployment.yaml": [
+            workload_deployment(
+                spec.app,
+                command=spec.command,
+                env=loadgen_env(intensity=spec.intensity),
+                tpu_limit=spec.tpu_limit,
+                topology=spec.topology,
+                accelerator=spec.accelerator,
+            )
+        ],
+        f"{spec.app}-prometheusrule.yaml": [
+            prometheusrule_manifest(
+                spec.app, groups=[(spec.app, [spec.recording_rule()])]
+            )
+        ],
+        f"{spec.app}-adapter-values.yaml": [
+            adapter_values([adapter_rule(spec.record)])
+        ],
+        f"{spec.app}-hpa.yaml": [
+            hpa_manifest(
+                spec.app,
+                metrics=[
+                    object_metric(spec.record, "Deployment", spec.app, spec.target)
+                ],
+                min_replicas=spec.min_replicas,
+                max_replicas=spec.max_replicas,
+            )
+        ],
+    }
+
+
+def to_yaml(docs: list[dict]) -> str:
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(doc, sort_keys=False, default_flow_style=False)
+        for doc in docs
+    )

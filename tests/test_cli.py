@@ -1,0 +1,68 @@
+"""CLI umbrella: gen-pipeline / gen-manifests command behavior.
+
+The runtime roles (exporter, loadgen, ...) are thin dispatchers to mains that
+have their own tests; here we cover the operator-facing generators end to end
+through the argparse surface.
+"""
+
+import yaml
+
+from k8s_gpu_hpa_tpu.__main__ import main
+
+
+def test_gen_pipeline_writes_consistent_files(tmp_path, capsys):
+    rc = main(
+        [
+            "gen-pipeline",
+            "--app",
+            "serve-llm",
+            "--metric",
+            "duty-cycle",
+            "--target",
+            "55",
+            "--max-replicas",
+            "6",
+            "--tpu-limit",
+            "4",
+            "--topology",
+            "2x2",
+            "-o",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    names = {p.name for p in tmp_path.iterdir()}
+    assert names == {
+        "serve-llm-deployment.yaml",
+        "serve-llm-prometheusrule.yaml",
+        "serve-llm-adapter-values.yaml",
+        "serve-llm-hpa.yaml",
+    }
+    hpa = yaml.safe_load((tmp_path / "serve-llm-hpa.yaml").read_text())
+    assert hpa["spec"]["maxReplicas"] == 6
+    metric = hpa["spec"]["metrics"][0]["object"]["metric"]["name"]
+    rule_doc = yaml.safe_load((tmp_path / "serve-llm-prometheusrule.yaml").read_text())
+    assert rule_doc["spec"]["groups"][0]["rules"][0]["record"] == metric
+    dep = yaml.safe_load((tmp_path / "serve-llm-deployment.yaml").read_text())
+    limits = dep["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 4
+
+
+def test_gen_pipeline_stdout_is_valid_yaml(capsys):
+    assert main(["gen-pipeline", "--app", "demo"]) == 0
+    out = capsys.readouterr().out
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    assert len(docs) == 4
+
+
+def test_gen_manifests_check_passes_on_shipped_tree(capsys):
+    assert main(["gen-manifests", "--check"]) == 0
+    assert "agree with the generator" in capsys.readouterr().out
+
+
+def test_gen_manifests_writes_loadable_files(tmp_path):
+    assert main(["gen-manifests", "-o", str(tmp_path)]) == 0
+    files = list(tmp_path.glob("*.yaml"))
+    assert len(files) == 14
+    for f in files:
+        assert list(yaml.safe_load_all(f.read_text()))

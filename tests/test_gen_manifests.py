@@ -1,0 +1,144 @@
+"""The manifest generator is the single source of truth for string contracts.
+
+Every contract-bearing file in deploy/ must be semantically identical to what
+k8s_gpu_hpa_tpu/manifests.py builds (comments aside) — the cure for the
+reference's failure mode of hand-duplicated strings that must agree across
+files (SURVEY.md §1: "breaking any one string silently breaks the loop").
+
+Also covers the parameterized PipelineSpec: a rendered custom pipeline must be
+internally consistent (every joint's string derived from the app name once)
+and must actually close the loop in the simulator.
+"""
+
+from pathlib import Path
+
+import pytest
+import yaml
+
+from k8s_gpu_hpa_tpu import manifests
+from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.hpa import (
+    HPAController,
+    behavior_from_manifest,
+    metrics_from_manifest,
+)
+from k8s_gpu_hpa_tpu.metrics.rules import RuleEvaluator
+from k8s_gpu_hpa_tpu.metrics.schema import TPU_DUTY_CYCLE
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+DEPLOY = Path(__file__).parent.parent / "deploy"
+
+BUNDLE = manifests.default_bundle()
+
+
+@pytest.mark.parametrize("filename", sorted(BUNDLE))
+def test_shipped_manifest_matches_generator(filename):
+    shipped = list(yaml.safe_load_all((DEPLOY / filename).read_text()))
+    assert shipped == BUNDLE[filename], (
+        f"{filename} disagrees with manifests.py — change the contract in one "
+        "place only (the generator) and regenerate"
+    )
+
+
+def test_bundle_covers_every_shipped_file():
+    generated_elsewhere = {"grafana-dashboard.yaml"}  # tools/gen_grafana_dashboard.py
+    shipped = {p.name for p in DEPLOY.glob("*.yaml")}
+    assert shipped == set(BUNDLE) | generated_elsewhere
+
+
+def test_pipeline_spec_strings_are_derived_once():
+    spec = manifests.PipelineSpec(app="my-model", device_metric=TPU_DUTY_CYCLE, target="55")
+    files = manifests.render_pipeline(spec)
+
+    dep = files["my-model-deployment.yaml"][0]
+    rule_doc = files["my-model-prometheusrule.yaml"][0]
+    adapter_doc = files["my-model-adapter-values.yaml"][0]
+    hpa_doc = files["my-model-hpa.yaml"][0]
+
+    # the join key appears in the workload...
+    assert dep["spec"]["template"]["metadata"]["labels"]["app"] == "my-model"
+    # ...the rule joins on it and records the derived series
+    rule = rule_doc["spec"]["groups"][0]["rules"][0]
+    assert 'label_app="my-model"' in rule["expr"]
+    assert rule["record"] == "my_model_duty_cycle_avg"
+    assert rule["labels"] == {"namespace": "default", "deployment": "my-model"}
+    # ...the adapter exposes exactly that series
+    custom = adapter_doc["rules"]["custom"]
+    assert len(custom) == 1 and "my_model_duty_cycle_avg" in custom[0]["seriesQuery"]
+    # ...and the HPA consumes it at the requested target
+    metric = hpa_doc["spec"]["metrics"][0]["object"]
+    assert metric["metric"]["name"] == "my_model_duty_cycle_avg"
+    assert metric["target"]["value"] == "55"
+    assert metric["describedObject"]["name"] == "my-model"
+
+
+def test_pipeline_spec_rejects_unknown_metric():
+    with pytest.raises(ValueError, match="unknown device metric"):
+        manifests.PipelineSpec(app="x", device_metric="tpu_bogus")
+
+
+@pytest.mark.parametrize("bad", ["My.App", "UPPER", "-lead", "trail-", "a" * 64, ""])
+def test_pipeline_spec_rejects_non_dns1123_app(bad):
+    with pytest.raises(ValueError, match="DNS-1123"):
+        manifests.PipelineSpec(app=bad)
+
+
+def test_rendered_pipeline_closes_loop_in_simulator():
+    """A custom pipeline straight out of render_pipeline() scales in the
+    closed-loop harness: rule AST from the spec, HPA parsed from the rendered
+    manifest — no hand-wiring in between."""
+    spec = manifests.PipelineSpec(app="custom-app", target="40", max_replicas=3)
+    files = manifests.render_pipeline(spec)
+    hpa_doc = files["custom-app-hpa.yaml"][0]
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    rule = spec.recording_rule()
+
+    class Target:
+        replicas = 1
+
+        def scale_to(self, n):
+            self.replicas = n
+
+    target = Target()
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series=spec.record)])
+    hpa = HPAController(
+        target=target,
+        metrics=metrics_from_manifest(hpa_doc),
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+    spec_obj = hpa.metrics[0]
+    assert isinstance(spec_obj.described_object, ObjectReference)
+
+    evaluator = RuleEvaluator(db, [rule])
+    for step in range(40):
+        now = clock.now()
+        for pod in [f"custom-app-{i}" for i in range(target.replicas)]:
+            db.append(
+                spec.device_metric,
+                (("chip", "0"), ("namespace", "default"), ("node", "n0"), ("pod", pod)),
+                95.0,
+                now,
+            )
+            db.append(
+                "kube_pod_labels",
+                (("label_app", "custom-app"), ("namespace", "default"), ("pod", pod)),
+                1.0,
+                now,
+            )
+        evaluator.evaluate_once()
+        if step % 15 == 14:
+            hpa.sync_once()
+        clock.advance(1.0)
+    assert target.replicas == 3
+
+
+def test_to_yaml_round_trips():
+    docs = BUNDLE["tpu-metrics-exporter.yaml"]
+    assert list(yaml.safe_load_all(manifests.to_yaml(docs))) == docs
