@@ -101,6 +101,7 @@ struct TpuExporter {
   std::vector<TpuChipSample> samples;               // guarded by mu
   std::map<int32_t, std::pair<std::string, std::string>> attribution;  // mu
   int64_t last_push_ms = -1;                        // guarded by mu
+  uint64_t push_count = 0;                          // guarded by mu
 
   std::atomic<uint64_t> request_count{0};
   std::atomic<bool> shutdown{false};
@@ -128,6 +129,18 @@ struct TpuExporter {
              EscapeLabel(node_name) + "\"} " +
              FormatValue(static_cast<double>(now - last_push_ms) / 1000.0) + "\n";
     }
+    // Counters for both directions of the L2<->L3 joint: sweeps says whether
+    // the collector loop is alive (its rate is the real collect interval),
+    // scrapes says whether Prometheus is actually pulling this endpoint.
+    out += "# HELP tpu_metrics_exporter_collect_sweeps_total chip-reading sweeps pushed\n";
+    out += "# TYPE tpu_metrics_exporter_collect_sweeps_total counter\n";
+    out += "tpu_metrics_exporter_collect_sweeps_total{node=\"" +
+           EscapeLabel(node_name) + "\"} " + std::to_string(push_count) + "\n";
+    out += "# HELP tpu_metrics_exporter_scrapes_total /metrics requests served\n";
+    out += "# TYPE tpu_metrics_exporter_scrapes_total counter\n";
+    out += "tpu_metrics_exporter_scrapes_total{node=\"" + EscapeLabel(node_name) +
+           "\"} " +
+           std::to_string(request_count.load(std::memory_order_relaxed)) + "\n";
     if (!fresh) return out;  // withhold stale chip gauges entirely
 
     for (int m = 0; m < 5; ++m) {
@@ -285,6 +298,7 @@ void tpu_exporter_push_samples(TpuExporter* ex, const TpuChipSample* samples,
   std::lock_guard<std::mutex> lock(ex->mu);
   ex->samples.assign(samples, samples + (n > 0 ? n : 0));
   ex->last_push_ms = NowMs();
+  ++ex->push_count;
 }
 
 void tpu_exporter_set_attribution(TpuExporter* ex, int32_t accel_index,

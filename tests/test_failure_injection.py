@@ -1,0 +1,167 @@
+"""Failure injection: what the loop does when a joint breaks mid-flight.
+
+The reference documents exactly one failure mode (scale-up overshoot,
+README.md:123) and tests none.  These scenarios break each pipeline joint in a
+running closed loop and assert the degraded behavior is the *safe* one:
+
+- a dead node exporter degrades coverage, it does not zero the signal;
+- a dead Prometheus (total scrape outage) makes the HPA hold, not scale;
+- a dead kube-state-metrics breaks the app-scoping join the same way;
+- every outage is recoverable: service returns, loop resumes scaling;
+- load flapping around the target does not flap replicas (tolerance +
+  stabilization window).
+
+All hardware-free, all in virtual time.
+"""
+
+import pytest
+
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def make_pipeline(load_fn, *, nodes=2, chips=4, max_replicas=4):
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"tpu-node-{i}", chips) for i in range(nodes)],
+        pod_start_latency=12.0,
+    )
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", load_fn=load_fn, load_mode="shared"
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(
+        cluster, dep, target_value=40.0, max_replicas=max_replicas
+    )
+    pipe.start()
+    return clock, cluster, dep, pipe
+
+
+def fail_target(pipe, name):
+    """Make one scrape target start failing (connection-refused analog);
+    returns a restore function."""
+    for target in pipe.scraper.targets:
+        if target.name == name:
+            original = target.fetch
+
+            def refused():
+                raise ConnectionError(f"{name}: connection refused")
+
+            target.fetch = refused
+            return lambda: setattr(target, "fetch", original)
+    raise AssertionError(f"no target named {name}")
+
+
+def test_single_node_exporter_outage_degrades_not_zeroes():
+    """One of two node exporters dies while pods run on both nodes.  The
+    recorded average must keep being served from the surviving node's pods —
+    coverage degrades, the signal does not vanish and the HPA keeps control."""
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 320.0, chips=2)
+    clock.advance(120.0)  # spike drives toward max; pods land on both nodes
+    assert pipe.replicas() == 4
+    pods_by_node = {}
+    for pod in cluster.running_pods("tpu-test"):
+        pods_by_node.setdefault(pod.node, []).append(pod.name)
+    assert len(pods_by_node) == 2, "need pods on both nodes for the scenario"
+
+    fail_target(pipe, "exporter/tpu-node-1")
+    clock.advance(30.0)
+
+    # signal still present, computed from the surviving node only
+    value = pipe.db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"})
+    assert value is not None and value > 0
+    assert "unavailable" not in pipe.hpa.status.last_reason
+    # and replicas hold at max rather than dropping (shared 320% over the
+    # surviving pods still reads near-saturated)
+    assert pipe.replicas() == 4
+
+
+def test_total_scrape_outage_holds_then_recovers():
+    """Prometheus down: all exporter targets fail.  Series go stale, the HPA
+    holds its last decision for the whole outage; on recovery the loop resumes
+    and completes the pending scale-up."""
+    offered = {"value": 20.0}
+    clock, cluster, dep, pipe = make_pipeline(lambda t: offered["value"])
+    clock.advance(60.0)
+    assert pipe.replicas() == 1
+
+    restores = [
+        fail_target(pipe, t.name)
+        for t in list(pipe.scraper.targets)
+        if t.name.startswith("exporter/")
+    ]
+    offered["value"] = 320.0  # spike happens DURING the outage
+    clock.advance(180.0)
+    assert pipe.replicas() == 1, "must hold, not act on stale data"
+    assert "unavailable" in pipe.hpa.status.last_reason
+
+    for restore in restores:
+        restore()
+    clock.advance(90.0)
+    assert pipe.replicas() == 4, "recovery must complete the deferred scale-up"
+
+
+def test_kube_state_metrics_outage_breaks_join_safely():
+    """kube_pod_labels is the app-scoping join key (SURVEY.md §3.2).  Without
+    it the rule must produce nothing — the HPA holds; it must never fall back
+    to unscoped device metrics (which would count other apps' chips)."""
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 20.0)
+    clock.advance(60.0)
+    restore = fail_target(pipe, "kube-state-metrics")
+    clock.advance(60.0)
+    assert pipe.db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"}) is None
+    assert "unavailable" in pipe.hpa.status.last_reason
+    assert pipe.replicas() == 1
+
+    restore()
+    clock.advance(30.0)
+    assert (
+        pipe.db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"})
+        is not None
+    )
+
+
+def test_exporter_flap_marks_stale_then_fresh():
+    """An exporter that dies and comes back within one lookback window must
+    not serve frozen values while down (staleness markers beat the 5 min
+    lookback) and must serve fresh values immediately after returning."""
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 35.0, nodes=1)
+    clock.advance(30.0)
+    before = pipe.db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"})
+    assert before is not None
+
+    restore = fail_target(pipe, "exporter/tpu-node-0")
+    clock.advance(5.0)
+    assert (
+        pipe.db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"}) is None
+    ), "down target's series must go stale at the next scrape, not linger"
+
+    restore()
+    clock.advance(5.0)
+    after = pipe.db.latest("tpu_test_tensorcore_avg", {"deployment": "tpu-test"})
+    assert after is not None
+
+
+@pytest.mark.parametrize("period", [20.0, 60.0])
+def test_load_flapping_at_target_does_not_flap_replicas(period):
+    """Load oscillating ±5% around the 40% target: the 10% tolerance plus the
+    scale-down stabilization window must keep replicas steady — the flapping
+    caveat the reference leaves to the operator (README.md:123)."""
+
+    def load(t):
+        import math
+
+        return 80.0 + 8.0 * math.sin(2 * math.pi * t / period)  # 2 pods ≈ 40±4%
+
+    clock, cluster, dep, pipe = make_pipeline(load)
+    clock.advance(120.0)
+    settled = pipe.replicas()
+    events_before = len(pipe.scale_history)
+    clock.advance(600.0)
+    assert pipe.replicas() == settled
+    assert len(pipe.scale_history) - events_before <= 1, (
+        f"replica flapping: {pipe.scale_history}"
+    )

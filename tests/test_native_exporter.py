@@ -111,6 +111,31 @@ def test_no_push_ever_reports_down():
         assert "sample_age" not in text
 
 
+def test_self_observability_counters():
+    """Both directions of the L2<->L3 joint are observable: the sweep counter
+    tracks collector pushes, the scrape counter tracks /metrics requests —
+    and both survive a staleness blackout (counters keep being served even
+    when chip gauges are withheld)."""
+    with NativeExporter("n0", listen_addr="127.0.0.1", port=0, staleness_ms=50) as ex:
+        ex.push(chips_fixture())
+        ex.push(chips_fixture())
+        _, body = http_get(ex.port)
+        fams = {f.name: f for f in parse_text(body)}
+        assert fams["tpu_metrics_exporter_collect_sweeps_total"].samples[0].value == 2
+        assert fams["tpu_metrics_exporter_collect_sweeps_total"].type == "counter"
+        # the request being served is counted before rendering
+        assert fams["tpu_metrics_exporter_scrapes_total"].samples[0].value == 1
+
+        import time
+
+        time.sleep(0.15)  # let the watchdog trip
+        _, body = http_get(ex.port)
+        fams = {f.name: f for f in parse_text(body)}
+        assert TPU_TENSORCORE_UTIL not in fams
+        assert fams["tpu_metrics_exporter_collect_sweeps_total"].samples[0].value == 2
+        assert fams["tpu_metrics_exporter_scrapes_total"].samples[0].value == 2
+
+
 def test_unallocated_chips_export_empty_pod():
     with NativeExporter("n0", port=-1) as ex:
         ex.push(chips_fixture())
