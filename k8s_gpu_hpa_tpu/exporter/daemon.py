@@ -90,10 +90,29 @@ def main() -> None:
 
     source_kind = os.environ.get("SOURCE", "libtpu")
     if source_kind == "stub":
-        from k8s_gpu_hpa_tpu.exporter.sources import StubSource
+        from k8s_gpu_hpa_tpu.exporter.sources import StubSource, file_util_fn
 
-        source: MetricsSource = StubSource()
-        attributor = None
+        # File-driven utilization knob (analog of the loadgen's intensity
+        # file): `kubectl exec <exporter-pod> -- sh -c 'echo 90 > /tmp/stub-util'`
+        # drives the whole no-TPU e2e loop (tools/kind-e2e.sh).
+        source: MetricsSource = StubSource(
+            num_chips=int(os.environ.get("STUB_CHIPS", "4")),
+            util_fn=file_util_fn(
+                os.environ.get("STUB_UTIL_FILE", "/tmp/stub-util"),
+                default=float(os.environ.get("STUB_UTIL", "20")),
+            ),
+        )
+        attribute_app = os.environ.get("ATTRIBUTE_APP", "")
+        if attribute_app:
+            from k8s_gpu_hpa_tpu.exporter.kubeapi import KubeApiAttributor
+
+            attributor = KubeApiAttributor(
+                attribute_app,
+                namespace=os.environ.get("ATTRIBUTE_NAMESPACE", "default"),
+                num_chips=int(os.environ.get("STUB_CHIPS", "4")),
+            )
+        else:
+            attributor = None
     elif source_kind == "jax":
         from k8s_gpu_hpa_tpu.exporter.sources import JaxDeviceSource
 

@@ -66,6 +66,22 @@ class StubSource:
         return chips
 
 
+def file_util_fn(path: str, default: float = 20.0):
+    """A StubSource ``util_fn`` that reads a percent from a watched file —
+    the exporter-side analog of the loadgen's intensity knob, so the kind-e2e
+    harness can drive scale-up with one ``kubectl exec`` (README.md:113-116's
+    "double the load" trick without any accelerator)."""
+
+    def util_fn(t: float, chip_index: int) -> float:
+        try:
+            with open(path) as f:
+                return float(f.read().strip())
+        except (OSError, ValueError):
+            return default
+
+    return util_fn
+
+
 class JaxDeviceSource:
     """Samples the local JAX devices directly.
 

@@ -1,0 +1,167 @@
+"""KubeApiAttributor + the stub knob: the no-TPU e2e path's moving parts.
+
+The kind-e2e harness (tools/kind-e2e.sh) replaces PodResources attribution
+with the Kubernetes API and libtpu with a file-driven stub.  These tests run
+the attributor against a fake API server (stdlib http) and the knob against a
+real temp file — the same joints the harness exercises in-cluster.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+from k8s_gpu_hpa_tpu.exporter.kubeapi import KubeApiAttributor
+from k8s_gpu_hpa_tpu.exporter.sources import StubSource, file_util_fn
+
+
+class FakeApiServer:
+    """Serves /api/v1/namespaces/{ns}/pods with a configurable pod list and
+    records the auth header + label selector of each request."""
+
+    def __init__(self):
+        self.pods = []
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                outer.requests.append(
+                    {
+                        "path": parsed.path,
+                        "selector": unquote(
+                            parse_qs(parsed.query).get("labelSelector", [""])[0]
+                        ),
+                        "auth": self.headers.get("Authorization", ""),
+                    }
+                )
+                body = json.dumps({"items": outer.pods}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def base(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def pod(name, phase="Running", deleting=False):
+    meta = {"name": name}
+    if deleting:
+        meta["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    return {"metadata": meta, "status": {"phase": phase}}
+
+
+@pytest.fixture()
+def api():
+    server = FakeApiServer()
+    yield server
+    server.close()
+
+
+def test_deals_chips_round_robin_over_running_pods(api):
+    api.pods = [pod("tpu-test-b"), pod("tpu-test-a"), pod("tpu-test-c")]
+    attr = KubeApiAttributor("tpu-test", num_chips=4, api_base=api.base, token="tok")
+    got = attr.list_allocations()
+    # sorted pod order, chips dealt round-robin
+    assert got == {
+        0: ("default", "tpu-test-a"),
+        1: ("default", "tpu-test-b"),
+        2: ("default", "tpu-test-c"),
+        3: ("default", "tpu-test-a"),
+    }
+    assert api.requests[0]["path"] == "/api/v1/namespaces/default/pods"
+    assert api.requests[0]["selector"] == "app=tpu-test"
+    assert api.requests[0]["auth"] == "Bearer tok"
+
+
+def test_skips_pending_and_terminating_pods(api):
+    api.pods = [
+        pod("tpu-test-a"),
+        pod("tpu-test-b", phase="Pending"),
+        pod("tpu-test-c", deleting=True),
+    ]
+    attr = KubeApiAttributor("tpu-test", num_chips=2, api_base=api.base, token="tok")
+    assert attr.list_allocations() == {
+        0: ("default", "tpu-test-a"),
+        1: ("default", "tpu-test-a"),
+    }
+
+
+def test_no_pods_means_no_attribution(api):
+    attr = KubeApiAttributor("tpu-test", api_base=api.base, token="tok")
+    assert attr.list_allocations() == {}
+
+
+def test_api_outage_raises_so_daemon_keeps_last_mapping(api):
+    """The daemon treats attributor exceptions as 'keep the last mapping'
+    (daemon.py) — the attributor must raise on API failure, not return {}."""
+    attr = KubeApiAttributor("tpu-test", api_base=api.base, token="tok")
+    api.close()
+    with pytest.raises(Exception):
+        attr.list_allocations()
+
+
+def test_file_util_fn_reads_knob(tmp_path):
+    knob = tmp_path / "stub-util"
+    fn = file_util_fn(str(knob), default=20.0)
+    assert fn(0.0, 0) == 20.0  # missing file -> default
+    knob.write_text("90\n")
+    assert fn(1.0, 0) == 90.0
+    knob.write_text("not-a-number")
+    assert fn(2.0, 0) == 20.0  # garbage -> default, never raises
+
+    source = StubSource(num_chips=2, util_fn=fn)
+    knob.write_text("55")
+    chips = source.sample()
+    assert [c.tensorcore_util for c in chips] == [55.0, 55.0]
+
+
+def test_kind_e2e_manifests_preserve_contracts():
+    """The stub exporter manifest must keep every string contract the shipped
+    scrape config and rules key on: Service name, port name, app join key."""
+    from pathlib import Path
+
+    import yaml
+
+    d = Path(__file__).parent.parent / "deploy/kind-e2e"
+    stub_docs = list(yaml.safe_load_all((d / "stub-exporter.yaml").read_text()))
+    by_kind = {}
+    for doc in stub_docs:
+        by_kind.setdefault(doc["kind"], []).append(doc)
+
+    svc = by_kind["Service"][0]
+    assert svc["metadata"]["name"] == "tpu-metrics-exporter"
+    assert svc["spec"]["ports"][0]["name"] == "metrics"
+
+    dep = by_kind["Deployment"][0]
+    env = {
+        e["name"]: e.get("value")
+        for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["SOURCE"] == "stub"
+    assert env["ATTRIBUTE_APP"] == "tpu-test"
+    assert float(env["STUB_UTIL"]) < 40.0  # starts below the HPA target
+
+    role = by_kind["Role"][0]
+    assert {"pods"} == set(role["rules"][0]["resources"])
+
+    workload = yaml.safe_load((d / "fake-workload.yaml").read_text())
+    assert workload["spec"]["template"]["metadata"]["labels"]["app"] == "tpu-test"
+    assert "replicas" not in workload["spec"]  # HPA owns replicas

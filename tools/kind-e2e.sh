@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# End-to-end integration of the whole L2→L5 pipeline on a kind cluster with
+# ZERO TPUs: stub exporter (same /metrics contract) + fake workload + the
+# SHIPPED Prometheus values, recording rules, adapter rules, and HPA.
+# This is the harness SURVEY.md §4 calls for ("integration-test the L3→L4→L5
+# loop without TPUs") — the reference has no equivalent.
+#
+# Requires: kind, kubectl, helm, docker, jq.  Takes ~6 minutes.
+# Usage: tools/kind-e2e.sh [--keep]    (--keep leaves the cluster running)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CLUSTER=tpu-hpa-e2e
+KEEP=${1:-}
+
+say() { printf '\n== %s\n' "$*"; }
+
+say "1/8 kind cluster"
+kind get clusters 2>/dev/null | grep -qx "$CLUSTER" || kind create cluster --name "$CLUSTER" --wait 120s
+kubectl config use-context "kind-$CLUSTER"
+
+say "2/8 build + load the exporter image"
+docker build -q -f docker/Dockerfile.exporter -t ghcr.io/k8s-tpu-hpa/tpu-metrics-exporter:0.1.0 .
+kind load docker-image --name "$CLUSTER" ghcr.io/k8s-tpu-hpa/tpu-metrics-exporter:0.1.0
+
+say "3/8 kube-prometheus-stack (shipped values: 1s tpu-metrics scrape job)"
+helm repo add prometheus-community https://prometheus-community.github.io/helm-charts >/dev/null
+helm repo update >/dev/null
+helm upgrade --install kube-prometheus-stack prometheus-community/kube-prometheus-stack \
+  -f deploy/kube-prometheus-stack-values.yaml --wait --timeout 5m
+
+say "4/8 workload + stub exporter (probe: exporter serves attributed chips)"
+kubectl apply -f deploy/kind-e2e/fake-workload.yaml
+kubectl apply -f deploy/kind-e2e/stub-exporter.yaml
+kubectl rollout status deploy/tpu-test deploy/tpu-metrics-exporter --timeout 120s
+kubectl port-forward svc/tpu-metrics-exporter 19400:9400 >/dev/null 2>&1 &
+PF1=$!; sleep 2
+curl -fsS localhost:19400/metrics | grep -q 'tpu_tensorcore_utilization{.*pod="tpu-test-' \
+  || { echo "FAIL: exporter not attributing chips to workload pods"; exit 1; }
+kill $PF1
+
+say "5/8 recording rules (probe: recorded series appears)"
+kubectl apply -f deploy/tpu-test-prometheusrule.yaml
+kubectl port-forward svc/kube-prometheus-stack-prometheus 19090:9090 >/dev/null 2>&1 &
+PF2=$!; sleep 2
+for i in $(seq 1 30); do
+  V=$(curl -fsS 'localhost:19090/api/v1/query?query=tpu_test_tensorcore_avg' | jq -r '.data.result[0].value[1] // empty')
+  [ -n "$V" ] && break; sleep 2
+done
+[ -n "${V:-}" ] || { echo "FAIL: tpu_test_tensorcore_avg never recorded"; exit 1; }
+echo "   tpu_test_tensorcore_avg=$V"
+
+say "6/8 prometheus-adapter (probe: metric on custom.metrics.k8s.io)"
+helm upgrade --install prometheus-adapter prometheus-community/prometheus-adapter \
+  -f deploy/prometheus-adapter-values.yaml --wait --timeout 3m
+for i in $(seq 1 30); do
+  kubectl get --raw /apis/custom.metrics.k8s.io/v1beta1 2>/dev/null | jq -r . | grep -q tpu_test_tensorcore_avg && break
+  sleep 2
+done
+kubectl get --raw /apis/custom.metrics.k8s.io/v1beta1 | jq -r . | grep -q tpu_test_tensorcore_avg \
+  || { echo "FAIL: adapter does not serve tpu_test_tensorcore_avg"; exit 1; }
+
+say "7/8 HPA + induced load (the closed-loop test: 1 -> 4 replicas)"
+kubectl apply -f deploy/tpu-test-hpa.yaml
+EXPORTER_POD=$(kubectl get pod -l app.kubernetes.io/name=tpu-metrics-exporter -o jsonpath='{.items[0].metadata.name}')
+kubectl exec "$EXPORTER_POD" -- sh -c 'echo 90 > /tmp/stub-util'
+DEADLINE=$(( $(date +%s) + 180 ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  READY=$(kubectl get deploy tpu-test -o jsonpath='{.status.readyReplicas}')
+  [ "${READY:-0}" -ge 4 ] && break
+  sleep 5
+done
+[ "${READY:-0}" -ge 4 ] || { echo "FAIL: scale-up did not reach 4 replicas"; kubectl describe hpa tpu-test; exit 1; }
+echo "   scaled to $READY replicas"
+
+say "8/8 scale-down path (drop the knob; stabilization window applies)"
+kubectl exec "$EXPORTER_POD" -- sh -c 'echo 10 > /tmp/stub-util'
+echo "   replicas will decay after the 120s stabilization window (not awaited)"
+
+kill $PF2 2>/dev/null || true
+say "E2E OK"
+[ "$KEEP" = "--keep" ] || kind delete cluster --name "$CLUSTER"
