@@ -1,0 +1,221 @@
+"""Slice-quantum operator: whole-slice scaling on a vanilla cluster.
+
+Multi-host TPU slices scale in quanta — one logical replica is
+``hosts_per_slice`` pods, and a partial slice blocks at the distributed-init
+barrier serving nothing (SURVEY.md §7(d)).  Our own controller implements the
+quantum natively (control/hpa.py), but on a real cluster the vanilla
+kube-controller-manager runs the HPA, and it has no quantum knob: a Percent
+policy or a mid-range metric can land replicas on a partial slice.
+
+This operator composes with the vanilla HPA instead of replacing it: it
+watches HPAs annotated ``k8s-tpu-hpa/replica-quantum: "<q>"``
+(deploy/tpu-test-multihost-hpa.yaml) and repairs the target's scale
+subresource whenever the HPA lands off a slice boundary:
+
+- scaling up (desired > current): round UP to the next whole slice — a
+  partial slice adds capacity only when completed;
+- scaling down / steady: round UP but never past the current count — hold
+  the extra hosts until the HPA itself removes a whole slice (mirrors
+  control/hpa.py's down-direction rule);
+- bounds snap inward to slice multiples, exactly as the controller does.
+
+Everything is stdlib REST against the API server (service-account token, no
+kubernetes client dependency) — the same pattern as exporter/kubeapi.py.
+Ships as a one-replica Deployment (deploy/quantum-operator.yaml).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import ssl
+import time
+import urllib.request
+from dataclasses import dataclass
+
+QUANTUM_ANNOTATION = "k8s-tpu-hpa/replica-quantum"
+TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+CACERT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+#: scaleTargetRef.kind -> (api group, plural) for the /scale subresource
+SCALE_PATHS = {
+    "Deployment": ("apps/v1", "deployments"),
+    "StatefulSet": ("apps/v1", "statefulsets"),
+    "ReplicaSet": ("apps/v1", "replicasets"),
+}
+
+
+class KubeClient:
+    """Minimal API-server client: GET + PATCH with the in-cluster token."""
+
+    def __init__(
+        self,
+        api_base: str | None = None,
+        token: str | None = None,
+        cacert_path: str | None = None,
+    ):
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            api_base = f"https://{host}:{port}"
+        self.api_base = api_base.rstrip("/")
+        self._token = token
+        self._cacert_path = cacert_path if cacert_path is not None else CACERT_PATH
+
+    def _read_token(self) -> str:
+        if self._token is not None:
+            return self._token
+        with open(TOKEN_PATH) as f:
+            return f.read().strip()
+
+    def _context(self) -> ssl.SSLContext | None:
+        if not self.api_base.startswith("https"):
+            return None
+        if os.path.exists(self._cacert_path):
+            return ssl.create_default_context(cafile=self._cacert_path)
+        return ssl.create_default_context()
+
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+        req = urllib.request.Request(self.api_base + path, method=method)
+        req.add_header("Authorization", f"Bearer {self._read_token()}")
+        req.add_header("Accept", "application/json")
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            req.add_header("Content-Type", "application/merge-patch+json")
+        with urllib.request.urlopen(
+            req, data=data, timeout=10, context=self._context()
+        ) as r:
+            return json.loads(r.read().decode() or "{}")
+
+    def get(self, path: str) -> dict:
+        return self._request("GET", path)
+
+    def patch(self, path: str, body: dict) -> dict:
+        return self._request("PATCH", path, body)
+
+
+@dataclass
+class RepairAction:
+    hpa: str
+    target: str  # "StatefulSet/tpu-test-multihost"
+    from_replicas: int
+    to_replicas: int
+    reason: str
+
+
+def quantum_desired(
+    current: int,
+    hpa_desired: int,
+    quantum: int,
+    min_replicas: int,
+    max_replicas: int,
+) -> int:
+    """The repair rule, shared verbatim with control/hpa.py's semantics:
+    growing rounds up to a whole slice, shrinking/steady rounds up but never
+    past ``current`` (hold the extra slice), bounds snap inward."""
+    q = quantum
+    max_q = max_replicas // q * q
+    min_q = min(math.ceil(min_replicas / q) * q, max_q)
+    if current % q == 0:
+        return current  # on a boundary; nothing to repair
+    if hpa_desired > current or current < min_q:
+        return min(math.ceil(current / q) * q, max_q)
+    # shrinking or steady off-boundary: the partial slice's hosts serve
+    # nothing — release them down to the whole-slice count
+    return max(current // q * q, min_q)
+
+
+class QuantumOperator:
+    """One reconcile loop over a namespace's annotated HPAs."""
+
+    def __init__(self, client: KubeClient, namespace: str = "default"):
+        self.client = client
+        self.namespace = namespace
+
+    def _list_hpas(self) -> list[dict]:
+        path = (
+            f"/apis/autoscaling/v2/namespaces/{self.namespace}"
+            "/horizontalpodautoscalers"
+        )
+        return self.client.get(path).get("items", [])
+
+    def reconcile_once(self) -> list[RepairAction]:
+        actions: list[RepairAction] = []
+        for hpa in self._list_hpas():
+            annotations = hpa["metadata"].get("annotations", {})
+            if QUANTUM_ANNOTATION not in annotations:
+                continue
+            q = int(annotations[QUANTUM_ANNOTATION])
+            if q <= 1:
+                continue
+            spec = hpa["spec"]
+            ref = spec["scaleTargetRef"]
+            if ref["kind"] not in SCALE_PATHS:
+                continue
+            group, plural = SCALE_PATHS[ref["kind"]]
+            scale_path = (
+                f"/apis/{group}/namespaces/{self.namespace}"
+                f"/{plural}/{ref['name']}/scale"
+            )
+            scale = self.client.get(scale_path)
+            current = int(scale.get("spec", {}).get("replicas") or 0)
+            if current == 0:
+                continue  # suspended/empty target: not the operator's call
+            status = hpa.get("status", {})
+            hpa_desired = int(status.get("desiredReplicas") or current)
+            desired = quantum_desired(
+                current,
+                hpa_desired,
+                q,
+                int(spec.get("minReplicas", 1)),
+                int(spec["maxReplicas"]),
+            )
+            if desired != current:
+                self.client.patch(scale_path, {"spec": {"replicas": desired}})
+                direction = "up" if desired > current else "down"
+                actions.append(
+                    RepairAction(
+                        hpa=hpa["metadata"]["name"],
+                        target=f"{ref['kind']}/{ref['name']}",
+                        from_replicas=current,
+                        to_replicas=desired,
+                        reason=(
+                            f"partial slice (quantum {q}): rounded {direction} "
+                            f"{current}->{desired}"
+                        ),
+                    )
+                )
+        return actions
+
+    def run_forever(self, interval: float = 5.0) -> None:
+        while True:
+            try:
+                for action in self.reconcile_once():
+                    print(
+                        f"repaired {action.target}: {action.reason}", flush=True
+                    )
+            except Exception as e:  # API blips: log and retry next tick
+                print(f"reconcile error: {e}", flush=True)
+            time.sleep(interval)
+
+
+def main() -> None:
+    """``python -m k8s_gpu_hpa_tpu.control.operator`` — the operator container.
+
+    Env: NAMESPACE (default "default"), INTERVAL_S (default 5).
+    """
+    operator = QuantumOperator(
+        KubeClient(), namespace=os.environ.get("NAMESPACE", "default")
+    )
+    print(
+        f"slice-quantum operator: namespace={operator.namespace}, "
+        f"annotation={QUANTUM_ANNOTATION}",
+        flush=True,
+    )
+    operator.run_forever(interval=float(os.environ.get("INTERVAL_S", "5")))
+
+
+if __name__ == "__main__":
+    main()

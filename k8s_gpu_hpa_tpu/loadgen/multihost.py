@@ -137,20 +137,47 @@ def main() -> None:
 
     import jax
 
-    from k8s_gpu_hpa_tpu.loadgen.allreduce import AllReduceLoadGen
     from k8s_gpu_hpa_tpu.loadgen.knob import IntensityKnob
     from k8s_gpu_hpa_tpu.parallel.mesh import make_mesh
 
     topology = initialize()
     mesh = make_mesh()
-    gen = AllReduceLoadGen(
-        mesh=mesh, buffer_mb=float(os.environ.get("BUFFER_MB", "64"))
-    )
+    workload = os.environ.get("WORKLOAD", "allreduce")
+    if workload == "ringattn":
+        # long-context rung: sequence-parallel attention over the slice's ring
+        from k8s_gpu_hpa_tpu.loadgen.ringattn import RingAttentionLoadGen
+
+        gen = RingAttentionLoadGen(
+            mesh=mesh,
+            seq_per_device=int(os.environ.get("SEQ_PER_DEVICE", "1024")),
+            heads=int(os.environ.get("HEADS", "8")),
+            head_dim=int(os.environ.get("HEAD_DIM", "128")),
+        )
+
+        def report(s):
+            return (
+                f"bursts={s.bursts} ctx={s.context_length} "
+                f"attn={s.achieved_tflops:.1f}TFLOP/s busy={s.seconds:.1f}s"
+            )
+
+    else:
+        from k8s_gpu_hpa_tpu.loadgen.allreduce import AllReduceLoadGen
+
+        gen = AllReduceLoadGen(
+            mesh=mesh, buffer_mb=float(os.environ.get("BUFFER_MB", "64"))
+        )
+
+        def report(s):
+            return (
+                f"rounds={s.rounds} ici={s.achieved_gbps:.1f}GB/s "
+                f"busy={s.seconds:.1f}s"
+            )
+
     gen.warmup()
     knob = IntensityKnob()
     report_every = float(os.environ.get("REPORT_S", "10"))
     print(
-        f"tpu-test multihost loadgen: process {jax.process_index()}/"
+        f"tpu-test multihost loadgen ({workload}): process {jax.process_index()}/"
         f"{jax.process_count()} slice="
         f"{topology.slice_index if topology else 0} mesh={dict(mesh.shape)} "
         f"(knob: {knob.file})",
@@ -163,12 +190,7 @@ def main() -> None:
         else:
             knob.throttle(gen.step())
         if time.perf_counter() - last_report >= report_every:
-            s = gen.stats()
-            print(
-                f"rounds={s.rounds} ici={s.achieved_gbps:.1f}GB/s "
-                f"busy={s.seconds:.1f}s",
-                flush=True,
-            )
+            print(report(gen.stats()), flush=True)
             last_report = time.perf_counter()
 
 

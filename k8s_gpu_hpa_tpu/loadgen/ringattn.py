@@ -1,0 +1,88 @@
+"""Long-context load generator: ring attention bursts over the mesh.
+
+The sequence-parallel serving load profile — each burst is exact attention
+over a context ``n_devices`` times longer than one chip could hold, mixing
+MXU work (two matmuls per ring step) with ICI traffic (the KV ring).  Drives
+the same duty-cycle knob and self-reporting contract as the other generators,
+so it plugs into the exporter/HPA pipeline unchanged.  Selectable in the
+multi-host container via ``WORKLOAD=ringattn`` (loadgen/multihost.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.ops.ring_attention import ring_attention
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+
+@dataclass
+class RingAttnStats:
+    bursts: int
+    context_length: int  # total sequence length across the ring
+    achieved_tflops: float  # attention FLOPs over busy time
+    seconds: float
+
+
+class RingAttentionLoadGen:
+    """Busy-loop of causal ring-attention passes over a long context."""
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        seq_per_device: int = 1024,
+        batch: int = 1,
+        heads: int = 8,
+        head_dim: int = 128,
+        dtype=jnp.bfloat16,
+    ):
+        self.mesh = mesh or make_mesh()
+        n = self.mesh.shape[DATA_AXIS]
+        self.seq = seq_per_device * n
+        self.batch, self.heads, self.head_dim = batch, heads, head_dim
+        key = jax.random.PRNGKey(0)
+        shape = (batch, self.seq, heads, head_dim)
+        sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        ks = jax.random.split(key, 3)
+        self._q = jax.device_put(jax.random.normal(ks[0], shape, dtype), sharding)
+        self._k = jax.device_put(jax.random.normal(ks[1], shape, dtype), sharding)
+        self._v = jax.device_put(jax.random.normal(ks[2], shape, dtype), sharding)
+
+        def burst(q, k, v):
+            out = ring_attention(q, k, v, self.mesh, causal=True)
+            # scalar probe forces completion without pulling the big array
+            return out.astype(jnp.float32).ravel()[0]
+
+        self._burst = jax.jit(burst)
+        self._bursts = 0
+        self._busy = 0.0
+
+    def warmup(self) -> None:
+        float(self._burst(self._q, self._k, self._v))
+
+    def step(self) -> float:
+        t0 = time.perf_counter()
+        float(self._burst(self._q, self._k, self._v))
+        dt = time.perf_counter() - t0
+        self._busy += dt
+        self._bursts += 1
+        return dt
+
+    def stats(self) -> RingAttnStats:
+        # causal attention: ~half the S^2 score/value work of full attention
+        flops_per_burst = 4.0 * self.batch * self.heads * self.seq**2 * self.head_dim / 2
+        return RingAttnStats(
+            bursts=self._bursts,
+            context_length=self.seq,
+            achieved_tflops=(
+                flops_per_burst * self._bursts / self._busy / 1e12
+                if self._busy
+                else 0.0
+            ),
+            seconds=self._busy,
+        )

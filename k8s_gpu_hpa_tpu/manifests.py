@@ -597,6 +597,89 @@ def _cpu_busyloop() -> dict:
     }
 
 
+def quantum_operator_bundle() -> list[dict]:
+    """The slice-quantum operator (control/operator.py): ServiceAccount, RBAC
+    for HPA reads + scale-subresource patches, and the one-replica
+    Deployment.  The annotation contract lives in control/operator.py
+    (QUANTUM_ANNOTATION) and the HPA manifests."""
+    name = "quantum-operator"
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount", "metadata": {"name": name}},
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": name},
+            "rules": [
+                {
+                    "apiGroups": ["autoscaling"],
+                    "resources": ["horizontalpodautoscalers"],
+                    "verbs": ["get", "list"],
+                },
+                {
+                    "apiGroups": ["apps"],
+                    "resources": [
+                        "deployments/scale",
+                        "statefulsets/scale",
+                        "replicasets/scale",
+                    ],
+                    "verbs": ["get", "patch"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": name},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": name,
+            },
+            "subjects": [{"kind": "ServiceAccount", "name": name}],
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "labels": {"app": name}},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "serviceAccountName": name,
+                        "containers": [
+                            {
+                                "name": "operator",
+                                "image": EXPORTER_IMAGE,
+                                "command": [
+                                    "python",
+                                    "-m",
+                                    "k8s_gpu_hpa_tpu.control.operator",
+                                ],
+                                "env": [
+                                    {
+                                        "name": "NAMESPACE",
+                                        "valueFrom": {
+                                            "fieldRef": {
+                                                "fieldPath": "metadata.namespace"
+                                            }
+                                        },
+                                    },
+                                    {"name": "INTERVAL_S", "value": "5"},
+                                ],
+                                "resources": {
+                                    "requests": {"cpu": "10m", "memory": "64Mi"}
+                                },
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+    ]
+
+
 def default_bundle() -> dict[str, list[dict]]:
     """filename -> document list for every contract-bearing shipped manifest.
 
@@ -685,6 +768,7 @@ def default_bundle() -> dict[str, list[dict]]:
                 },
             )
         ],
+        "quantum-operator.yaml": quantum_operator_bundle(),
         "cpu-busyloop.yaml": [_cpu_busyloop()],
         "cpu-busyloop-hpa.yaml": [
             hpa_manifest(

@@ -1,0 +1,128 @@
+"""Ring attention: sequence-parallel attention over an ICI ring.
+
+Long-context serving shards the *sequence* across chips — no single chip can
+hold the KV for a 1M-token context.  Ring attention (Liu et al., 2023) keeps
+Q resident and streams KV blocks around the mesh axis with ``ppermute`` while
+accumulating exact attention via online (flash-style) softmax: after N steps
+every Q block has seen every KV block, overlap hides the ICI hop, and memory
+stays O(seq/N) per chip.
+
+This is the framework's long-context load profile (the reference has no
+parallelism at all, SURVEY.md §2c): each burst drives the MXU (two matmuls
+per step per block) *and* the ICI ring — the mixed compute/communication
+signature of sequence-parallel serving, feeding the same HPA pipeline.
+
+Idiomatic construction: ``shard_map`` over the mesh axis, ``lax.fori_loop``
+over ring steps (static trip count — compiles once), f32 accumulators, bf16
+operands; collectives are explicit ``lax.ppermute`` so XLA lowers them onto
+ICI neighbors.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS
+
+NEG_INF = -1e30  # mask value; large-negative beats -inf for bf16/f32 exp math
+
+
+def _block_attn(q, k, v, q_off, k_off, causal):
+    """Scores and weighted values for one (Q block, KV block) pair.
+
+    Returns (m, l, o): per-row block max, sum of exp, and unnormalized
+    output — the online-softmax triple.  All f32.
+    """
+    # q: [b, lq, h, d], k/v: [b, lk, h, d]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(q.shape[-1]))
+    if causal:
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b, h, q]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [b, h, q]
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention with the sequence dimension sharded over ``axis``.
+
+    ``q``/``k``/``v``: [batch, seq, heads, head_dim], sharded on seq.  Each
+    ring step processes the resident KV block then rotates it one hop; the
+    online-softmax accumulators make the result exact regardless of block
+    arrival order.  Output is sharded like ``q``.
+    """
+    n = mesh.shape[axis]
+    seq_sharding = NamedSharding(mesh, P(None, axis))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        # the zero-initialized accumulators enter the fori_loop unvarying and
+        # leave it device-varying; skip the static vma check (same situation
+        # as loadgen/allreduce.py)
+        check_vma=False,
+    )
+    def ring(q, k, v):
+        b, lq, h, d = q.shape
+        lk = k.shape[1]
+        my = lax.axis_index(axis)
+        qf = q.astype(jnp.float32)
+
+        def step(s, carry):
+            m, l, o, kb, vb = carry
+            # the block resident at step s started on device (my - s) mod n
+            k_off = ((my - s) % n) * lk
+            bm, bl, bo = _block_attn(qf, kb.astype(jnp.float32), vb, my * lq, k_off, causal)
+            m_new = jnp.maximum(m, bm)
+            scale_old = jnp.exp(m - m_new)
+            scale_new = jnp.exp(bm - m_new)
+            l = l * scale_old + bl * scale_new
+            o = o * scale_old[..., None] + bo * scale_new[..., None]
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            return m_new, l, o, kb, vb
+
+        m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, lq), jnp.float32)
+        o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+        m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
+        # fully-masked rows (causal, all-future block) have l == 0: emit 0
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+    q = jax.device_put(q, seq_sharding)
+    k = jax.device_put(k, seq_sharding)
+    v = jax.device_put(v, seq_sharding)
+    return ring(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
+    """Single-device exact attention for testing parity."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(q.shape[-1]))
+    if causal:
+        lq, lk = s.shape[2], s.shape[3]
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
