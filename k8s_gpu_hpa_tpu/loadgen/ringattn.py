@@ -6,6 +6,13 @@ MXU work (two matmuls per ring step) with ICI traffic (the KV ring).  Drives
 the same duty-cycle knob and self-reporting contract as the other generators,
 so it plugs into the exporter/HPA pipeline unchanged.  Selectable in the
 multi-host container via ``WORKLOAD=ringattn`` (loadgen/multihost.py).
+
+Measured on v5e (b=1, ctx=8k, h=8, d=128): ~10 TFLOP/s busy-time regardless
+of kv chunking or layout — XLA-compiled flash attention at these shapes is
+VPU/softmax-bound, not MXU-bound (the matmul generator is the MXU-saturation
+rung; this one exists for the attention+ICI *profile*).  A Pallas flash
+kernel is the known next step if raw attention throughput ever becomes the
+goal.
 """
 
 from __future__ import annotations
@@ -40,11 +47,17 @@ class RingAttentionLoadGen:
         heads: int = 8,
         head_dim: int = 128,
         dtype=jnp.bfloat16,
+        passes_per_burst: int | None = None,
     ):
         self.mesh = mesh or make_mesh()
         n = self.mesh.shape[DATA_AXIS]
         self.seq = seq_per_device * n
         self.batch, self.heads, self.head_dim = batch, heads, head_dim
+        if passes_per_burst is None:
+            # chain passes inside one dispatch so tunnel/dispatch RTT doesn't
+            # dominate the measurement (same reason as matmul iters_per_burst)
+            passes_per_burst = 8 if jax.default_backend() == "tpu" else 1
+        self.passes_per_burst = passes_per_burst
         key = jax.random.PRNGKey(0)
         shape = (batch, self.seq, heads, head_dim)
         sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
@@ -54,7 +67,11 @@ class RingAttentionLoadGen:
         self._v = jax.device_put(jax.random.normal(ks[2], shape, dtype), sharding)
 
         def burst(q, k, v):
-            out = ring_attention(q, k, v, self.mesh, causal=True)
+            out = q
+            for _ in range(self.passes_per_burst):
+                # feed the output back as Q: data dependence defeats CSE, and
+                # values stay bounded (attention outputs are convex mixes of V)
+                out = ring_attention(out, k, v, self.mesh, causal=True)
             # scalar probe forces completion without pulling the big array
             return out.astype(jnp.float32).ravel()[0]
 
@@ -75,7 +92,15 @@ class RingAttentionLoadGen:
 
     def stats(self) -> RingAttnStats:
         # causal attention: ~half the S^2 score/value work of full attention
-        flops_per_burst = 4.0 * self.batch * self.heads * self.seq**2 * self.head_dim / 2
+        flops_per_burst = (
+            4.0
+            * self.batch
+            * self.heads
+            * self.seq**2
+            * self.head_dim
+            / 2
+            * self.passes_per_burst
+        )
         return RingAttnStats(
             bursts=self._bursts,
             context_length=self.seq,

@@ -32,11 +32,11 @@ from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS
 NEG_INF = -1e30  # mask value; large-negative beats -inf for bf16/f32 exp math
 
 
-def _block_attn(q, k, v, q_off, k_off, causal):
-    """Scores and weighted values for one (Q block, KV block) pair.
+def _chunk_attn(q, k, v, q_off, k_off, causal):
+    """Scores and weighted values for one (Q block, KV chunk) pair.
 
-    Returns (m, l, o): per-row block max, sum of exp, and unnormalized
-    output — the online-softmax triple.  All f32.
+    Returns (m, l, o): per-row max, sum of exp, and unnormalized output —
+    the online-softmax triple.  All f32.
     """
     # q: [b, lq, h, d], k/v: [b, lk, h, d]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -52,6 +52,51 @@ def _block_attn(q, k, v, q_off, k_off, causal):
     return m, l, o
 
 
+def _merge(m, l, o, bm, bl, bo):
+    """Fold one online-softmax triple into the running accumulators."""
+    m_new = jnp.maximum(m, bm)
+    scale_old = jnp.exp(m - m_new)
+    scale_new = jnp.exp(bm - m_new)
+    l = l * scale_old + bl * scale_new
+    o = o * scale_old[..., None] + bo * scale_new[..., None]
+    return m_new, l, o
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, kv_chunk):
+    """One (Q block, KV block) pair, the KV side scanned in chunks.
+
+    Without chunking the [lq, lk] score matrix materializes in full — at an
+    8k x 8k block that is gigabytes of f32 HBM traffic per head and the op
+    goes memory-bound.  Chunking keeps the live score slab at [lq, kv_chunk]
+    (flash-attention blocking), trading it for a lax.scan whose triple merges
+    are exact.  Returns the block's combined (m, l, o) triple.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if kv_chunk is None or kv_chunk >= lk or lk % kv_chunk != 0:
+        return _chunk_attn(q, k, v, q_off, k_off, causal)
+    n_chunks = lk // kv_chunk
+    # scan over [n_chunks, b, chunk, h, d] slices of K/V
+    ks = k.reshape(b, n_chunks, kv_chunk, h, d).transpose(1, 0, 2, 3, 4)
+    vs = v.reshape(b, n_chunks, kv_chunk, h, d).transpose(1, 0, 2, 3, 4)
+
+    def step(carry, inputs):
+        i, (kc, vc) = inputs
+        m, l, o = carry
+        bm, bl, bo = _chunk_attn(
+            q, kc, vc, q_off, k_off + i * kv_chunk, causal
+        )
+        return _merge(m, l, o, bm, bl, bo), None
+
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    (m, l, o), _ = lax.scan(
+        step, (m0, l0, o0), (jnp.arange(n_chunks), (ks, vs))
+    )
+    return m, l, o
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -59,6 +104,7 @@ def ring_attention(
     mesh: Mesh,
     axis: str = DATA_AXIS,
     causal: bool = False,
+    kv_chunk: int | None = 512,
 ) -> jax.Array:
     """Exact attention with the sequence dimension sharded over ``axis``.
 
@@ -66,6 +112,10 @@ def ring_attention(
     ring step processes the resident KV block then rotates it one hop; the
     online-softmax accumulators make the result exact regardless of block
     arrival order.  Output is sharded like ``q``.
+
+    ``kv_chunk`` blocks the local KV dimension flash-style so the score slab
+    stays [lq, kv_chunk] instead of [lq, lk] (None or non-dividing chunk:
+    unchunked).
     """
     n = mesh.shape[axis]
     seq_sharding = NamedSharding(mesh, P(None, axis))
@@ -90,16 +140,14 @@ def ring_attention(
             m, l, o, kb, vb = carry
             # the block resident at step s started on device (my - s) mod n
             k_off = ((my - s) % n) * lk
-            bm, bl, bo = _block_attn(qf, kb.astype(jnp.float32), vb, my * lq, k_off, causal)
-            m_new = jnp.maximum(m, bm)
-            scale_old = jnp.exp(m - m_new)
-            scale_new = jnp.exp(bm - m_new)
-            l = l * scale_old + bl * scale_new
-            o = o * scale_old[..., None] + bo * scale_new[..., None]
+            bm, bl, bo = _block_attn(
+                qf, kb.astype(jnp.float32), vb, my * lq, k_off, causal, kv_chunk
+            )
+            m, l, o = _merge(m, l, o, bm, bl, bo)
             perm = [(j, (j + 1) % n) for j in range(n)]
             kb = lax.ppermute(kb, axis, perm)
             vb = lax.ppermute(vb, axis, perm)
-            return m_new, l, o, kb, vb
+            return m, l, o, kb, vb
 
         m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
         l0 = jnp.zeros((b, h, lq), jnp.float32)
