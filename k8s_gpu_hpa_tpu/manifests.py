@@ -434,7 +434,22 @@ def adapter_rule(series: str, resource: str = "deployment") -> dict:
     }
 
 
-def adapter_values(rules: list[dict] | None = None) -> dict:
+def external_rule(series: str) -> dict:
+    """One ``externalRules`` entry: a series served on
+    ``external.metrics.k8s.io``, addressed by name + label selector within the
+    namespace — no Kubernetes object association (the queue-depth idiom)."""
+    return {
+        "seriesQuery": f'{series}{{namespace!=""}}',
+        "resources": {"overrides": {"namespace": {"resource": "namespace"}}},
+        "name": {"as": series},
+        "metricsQuery": "sum by (<<.GroupBy>>) (<<.Series>>{<<.LabelMatchers>>})",
+    }
+
+
+def adapter_values(
+    rules: list[dict] | None = None,
+    external_rules: list[dict] | None = None,
+) -> dict:
     if rules is None:
         rules = [
             adapter_rule("tpu_test_tensorcore_avg"),
@@ -445,9 +460,15 @@ def adapter_values(rules: list[dict] | None = None) -> dict:
             adapter_rule("tpu_train_hbm_bw_avg"),
             adapter_rule("tpu_test_multihost_tensorcore_avg", resource="statefulset"),
         ]
+    if external_rules is None:
+        external_rules = [external_rule("tpu_test_queue_depth")]
     return {
         "prometheus": {"url": PROMETHEUS_URL, "port": 9090},
-        "rules": {"default": False, "custom": rules},
+        "rules": {
+            "default": False,
+            "custom": rules,
+            "external": external_rules,
+        },
     }
 
 
@@ -766,6 +787,29 @@ def default_bundle() -> dict[str, list[dict]]:
                         ],
                     },
                 },
+            )
+        ],
+        "tpu-test-external-hpa.yaml": [
+            hpa_manifest(
+                "tpu-test-queue",
+                target_name="tpu-test",
+                metrics=[
+                    {
+                        "type": "External",
+                        "external": {
+                            "metric": {
+                                "name": "tpu_test_queue_depth",
+                                "selector": {
+                                    "matchLabels": {"queue": "tpu-test"}
+                                },
+                            },
+                            "target": {
+                                "type": "AverageValue",
+                                "averageValue": "100",
+                            },
+                        },
+                    }
+                ],
             )
         ],
         "quantum-operator.yaml": quantum_operator_bundle(),

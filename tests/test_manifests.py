@@ -386,3 +386,70 @@ def test_shipped_hpa_clears_north_star_in_simulation():
     pipeline.run_for(300.0)
     late = [e for e in pipeline.scale_history if e[0] > 200.0]
     assert late == []
+
+
+def test_shipped_external_hpa_scales_on_queue_depth():
+    """The External rung closed-loop: the shipped tpu-test-external-hpa.yaml
+    parsed into the controller, queue depth served on external.metrics.k8s.io
+    semantics (sum of matched series / replicas vs the AverageValue target).
+    240 queued requests at target 100/replica -> 3 replicas; drain -> decay
+    to min after the stabilization window."""
+    from k8s_gpu_hpa_tpu.control.adapter import CustomMetricsAdapter, ExternalRule
+    from k8s_gpu_hpa_tpu.control.hpa import (
+        HPAController,
+        behavior_from_manifest,
+        metrics_from_manifest,
+    )
+    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    hpa_doc = load("tpu-test-external-hpa.yaml")
+    adapter_doc = load("prometheus-adapter-values.yaml")
+    # the series the HPA consumes must be served by an externalRules entry
+    series = hpa_doc["spec"]["metrics"][0]["external"]["metric"]["name"]
+    assert any(
+        rule["name"]["as"] == series for rule in adapter_doc["rules"]["external"]
+    )
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    adapter = CustomMetricsAdapter(db, [], external_rules=[ExternalRule(series)])
+
+    class Target:
+        replicas = 1
+
+        def scale_to(self, n):
+            self.replicas = n
+
+    target = Target()
+    hpa = HPAController(
+        target=target,
+        metrics=metrics_from_manifest(hpa_doc),
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+
+    def publish(depth):
+        db.append(
+            series,
+            (("namespace", "default"), ("queue", "tpu-test")),
+            depth,
+            clock.now(),
+        )
+
+    for step in range(60):  # queue at 240: 240/100 -> 3 replicas
+        publish(240.0)
+        if step % 15 == 14:
+            hpa.sync_once()
+        clock.advance(1.0)
+    assert target.replicas == 3
+
+    for step in range(200):  # drained: decay bounded by stabilization window
+        publish(10.0)
+        if step % 15 == 14:
+            hpa.sync_once()
+        clock.advance(1.0)
+    assert target.replicas == 1
