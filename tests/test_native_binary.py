@@ -1,0 +1,107 @@
+"""The standalone C++ exporter binary (cpp/exporter/main.cc): flag surface,
+stdin feed mode, and the /metrics contract — driven as a real subprocess.
+
+This is the pure-native deployment shape (no Python in the container); the
+stdin mode lets any process feed sweeps, which is also how this test injects
+deterministic readings.
+"""
+
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+
+REPO = Path(__file__).parent.parent
+BINARY = REPO / "cpp/build/tpu-metrics-exporter"
+
+
+def ensure_binary() -> Path:
+    if BINARY.exists():
+        return BINARY
+    subprocess.run(
+        ["cmake", "-S", str(REPO / "cpp"), "-B", str(REPO / "cpp/build"), "-G", "Ninja"],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", str(REPO / "cpp/build")], check=True, capture_output=True
+    )
+    return BINARY
+
+
+def wait_http(port: int, deadline: float = 10.0) -> str:
+    end = time.time() + deadline
+    while time.time() < end:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2
+            ) as r:
+                return r.read().decode()
+        except (urllib.error.URLError, ConnectionError):
+            time.sleep(0.1)
+    raise TimeoutError(f"no /metrics on :{port}")
+
+
+@pytest.fixture(scope="module")
+def binary():
+    return ensure_binary()
+
+
+def test_stdin_mode_serves_fed_sweep(binary):
+    port = 19417
+    proc = subprocess.Popen(
+        [str(binary), "--listen", f"127.0.0.1:{port}", "--node", "bin-node",
+         "--source", "stdin", "--collect-ms", "100"],
+        stdin=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        proc.stdin.write("0 75 80 8e9 16e9 45\n1 25 30 2e9 16e9 10\n\n")
+        proc.stdin.flush()
+        text = wait_http(port)
+        fams = {f.name: f for f in parse_text(text)}
+        up = fams["tpu_metrics_exporter_up"].samples[0]
+        assert up.value == 1.0 and up.label("node") == "bin-node"
+        utils = {
+            s.label("chip"): s.value
+            for s in fams["tpu_tensorcore_utilization"].samples
+        }
+        assert utils == {"0": 75.0, "1": 25.0}
+        assert fams["tpu_metrics_exporter_collect_sweeps_total"].samples[0].value == 1
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_stub_mode_serves_synthetic_chips(binary):
+    port = 19418
+    proc = subprocess.Popen(
+        [str(binary), "--listen", f"127.0.0.1:{port}", "--node", "stub-node",
+         "--source", "stub", "--collect-ms", "100"],
+        stderr=subprocess.PIPE,
+    )
+    try:
+        deadline = time.time() + 10
+        fams = {}
+        while time.time() < deadline and "tpu_tensorcore_utilization" not in fams:
+            fams = {f.name: f for f in parse_text(wait_http(port))}
+        assert len(fams["tpu_tensorcore_utilization"].samples) == 4
+        for s in fams["tpu_hbm_memory_total_bytes"].samples:
+            assert s.value == 16e9
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_bad_flag_exits_with_usage(binary):
+    proc = subprocess.run(
+        [str(binary), "--bogus"], capture_output=True, text=True
+    )
+    assert proc.returncode == 2
+    assert "usage" in proc.stderr
