@@ -21,7 +21,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.rules import (
+    AlertRule,
     RecordingRule,
+    pipeline_alert_rules,
     tpu_test_avg_rule,
     tpu_test_multihost_avg_rule,
     tpu_test_pod_max_rule,
@@ -345,6 +347,17 @@ def _rule_entry(rule: RecordingRule) -> dict:
     return entry
 
 
+def _alert_entry(rule: AlertRule) -> dict:
+    entry: dict = {"alert": rule.alert, "expr": rule.expr.promql()}
+    if rule.for_seconds:
+        entry["for"] = f"{int(rule.for_seconds)}s"
+    if rule.labels:
+        entry["labels"] = dict(rule.labels)
+    if rule.annotations:
+        entry["annotations"] = dict(rule.annotations)
+    return entry
+
+
 def shipped_rule_groups() -> list[tuple[str, list[RecordingRule]]]:
     """Every recording rule the shipped pipeline evaluates, grouped as in
     deploy/tpu-test-prometheusrule.yaml — built from the same tested ASTs the
@@ -394,21 +407,31 @@ def shipped_rule_groups() -> list[tuple[str, list[RecordingRule]]]:
 def prometheusrule_manifest(
     name: str = "tpu-test",
     groups: list[tuple[str, list[RecordingRule]]] | None = None,
+    alerts: list[AlertRule] | None = None,
 ) -> dict:
+    group_docs = [
+        {
+            "name": group_name,
+            "interval": RULE_INTERVAL,
+            "rules": [_rule_entry(r) for r in rules],
+        }
+        for group_name, rules in (groups or shipped_rule_groups())
+    ]
+    if alerts is None and groups is None:
+        alerts = pipeline_alert_rules()
+    if alerts:
+        group_docs.append(
+            {
+                "name": "tpu-pipeline-alerts",
+                "interval": RULE_INTERVAL,
+                "rules": [_alert_entry(a) for a in alerts],
+            }
+        )
     return {
         "apiVersion": "monitoring.coreos.com/v1",
         "kind": "PrometheusRule",
         "metadata": {"name": name, "labels": {"release": RELEASE_LABEL}},
-        "spec": {
-            "groups": [
-                {
-                    "name": group_name,
-                    "interval": RULE_INTERVAL,
-                    "rules": [_rule_entry(r) for r in rules],
-                }
-                for group_name, rules in (groups or shipped_rule_groups())
-            ]
-        },
+        "spec": {"groups": group_docs},
     }
 
 

@@ -147,6 +147,98 @@ class Avg(Expr):
 
 
 @dataclass
+class Aggregate(Expr):
+    """``min(child)`` / ``max(child)`` / ``sum(child)`` — whole-vector scalar
+    aggregation (``avg`` keeps its dedicated node for rendering parity with
+    the shipped rules)."""
+
+    op: str  # "min" | "max" | "sum"
+    child: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        vec = self.child.evaluate(db, at)
+        if not vec:
+            return []
+        values = [s.value for s in vec]
+        fn = {"min": min, "max": max, "sum": sum}[self.op]
+        return [Sample(fn(values), ())]
+
+    def promql(self) -> str:
+        return f"{self.op}({self.child.promql()})"
+
+
+@dataclass
+class Cmp(Expr):
+    """``child < threshold`` etc — PromQL filter semantics: samples that pass
+    the comparison survive, the rest drop (an alert fires on non-empty)."""
+
+    child: Expr
+    op: str  # "<" | ">" | "<=" | ">=" | "==" | "!="
+    threshold: float
+
+    _OPS = {
+        "<": lambda a, b: a < b,
+        ">": lambda a, b: a > b,
+        "<=": lambda a, b: a <= b,
+        ">=": lambda a, b: a >= b,
+        "==": lambda a, b: a == b,
+        "!=": lambda a, b: a != b,
+    }
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        fn = self._OPS[self.op]
+        return [s for s in self.child.evaluate(db, at) if fn(s.value, self.threshold)]
+
+    def promql(self) -> str:
+        t = self.threshold
+        rendered = str(int(t)) if t == int(t) else repr(t)
+        return f"{self.child.promql()} {self.op} {rendered}"
+
+
+@dataclass
+class Absent(Expr):
+    """``absent(child)`` — one sample when the child vector is empty (the
+    canonical dead-pipeline probe: a broken joint stops *producing*, it does
+    not produce zeros — SURVEY.md §1's silent-breakage failure mode)."""
+
+    child: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        if self.child.evaluate(db, at):
+            return []
+        return [Sample(1.0, ())]
+
+    def promql(self) -> str:
+        return f"absent({self.child.promql()})"
+
+
+@dataclass
+class AlertRule:
+    """One ``alert:`` rule with Prometheus ``for:`` semantics: the expr must
+    return a non-empty vector continuously for ``for_seconds`` before the
+    alert transitions pending → firing; one empty evaluation resets it."""
+
+    alert: str
+    expr: Expr
+    for_seconds: float = 0.0
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    _pending_since: float | None = field(default=None, repr=False)
+    firing: bool = field(default=False, repr=False)
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> bool:
+        now = db.clock.now() if at is None else at
+        if not self.expr.evaluate(db, at):
+            self._pending_since = None
+            self.firing = False
+            return False
+        if self._pending_since is None:
+            self._pending_since = now
+        self.firing = now - self._pending_since >= self.for_seconds
+        return self.firing
+
+
+@dataclass
 class RecordingRule:
     """``record:`` output series name, expression, and static output labels."""
 
@@ -179,15 +271,29 @@ class RecordingRule:
 class RuleEvaluator:
     """Evaluates a rule group on a schedule (Prometheus default interval 30s; we
     default to 1s to meet the 60s north-star latency budget — SURVEY.md §7
-    hard-part (b))."""
+    hard-part (b)).  Alert rules evaluate after recording rules each pass, as
+    in Prometheus group ordering (alerts may reference recorded series)."""
 
-    def __init__(self, db: TimeSeriesDB, rules: list[RecordingRule], interval: float = 1.0):
+    def __init__(
+        self,
+        db: TimeSeriesDB,
+        rules: list[RecordingRule],
+        interval: float = 1.0,
+        alerts: list[AlertRule] | None = None,
+    ):
         self.db = db
         self.rules = rules
         self.interval = interval
+        self.alerts = alerts or []
 
     def evaluate_once(self) -> int:
-        return sum(rule.evaluate_into(self.db) for rule in self.rules)
+        count = sum(rule.evaluate_into(self.db) for rule in self.rules)
+        for alert in self.alerts:
+            alert.evaluate(self.db)
+        return count
+
+    def firing_alerts(self) -> list[str]:
+        return [a.alert for a in self.alerts if a.firing]
 
 
 def tpu_test_avg_rule(
@@ -217,6 +323,55 @@ def tpu_test_avg_rule(
         expr=expr,
         labels={"namespace": namespace, "deployment": deployment},
     )
+
+
+def pipeline_alert_rules(
+    record: str = "tpu_test_tensorcore_avg",
+) -> list[AlertRule]:
+    """The pipeline's own health alerts — the joints' silent-breakage modes
+    (SURVEY.md §1) made loud.  The reference ships no alerting at all; these
+    cover the three ways the loop dies without an error surfacing anywhere:
+    an exporter stops being up, an exporter freezes (stale samples), or the
+    recorded autoscale series vanishes (any upstream joint broken)."""
+    return [
+        AlertRule(
+            alert="TpuExporterDown",
+            expr=Cmp(Aggregate("min", Select("tpu_metrics_exporter_up")), "<", 1),
+            for_seconds=30.0,
+            labels={"severity": "critical"},
+            annotations={
+                "summary": "a tpu-metrics-exporter is serving but its metric "
+                "source went stale (up=0); per-chip gauges are withheld"
+            },
+        ),
+        AlertRule(
+            alert="TpuExporterStale",
+            expr=Cmp(
+                Aggregate(
+                    "max", Select("tpu_metrics_exporter_sample_age_seconds")
+                ),
+                ">",
+                10,
+            ),
+            for_seconds=30.0,
+            labels={"severity": "warning"},
+            annotations={
+                "summary": "an exporter's newest chip reading is older than "
+                "10s (collect loop wedged or libtpu unresponsive)"
+            },
+        ),
+        AlertRule(
+            alert="TpuAutoscaleSignalAbsent",
+            expr=Absent(Select(record)),
+            for_seconds=60.0,
+            labels={"severity": "critical"},
+            annotations={
+                "summary": f"recorded series {record} is absent: scrape job, "
+                "recording rule, kube_pod_labels join, or the workload itself "
+                "is broken - the HPA is flying blind (holding)"
+            },
+        ),
+    ]
 
 
 def tpu_test_pod_max_rule(

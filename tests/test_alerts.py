@@ -1,0 +1,129 @@
+"""Alerting: the executable alert rules and their pipeline scenarios.
+
+The reference ships no alerting (SURVEY.md §5); these tests prove the shipped
+alert group catches the silent-breakage modes in a live loop — pending→firing
+``for:`` semantics included — and that the YAML on disk is these exact ASTs.
+"""
+
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    Absent,
+    Aggregate,
+    AlertRule,
+    Cmp,
+    RuleEvaluator,
+    Select,
+    pipeline_alert_rules,
+)
+from k8s_gpu_hpa_tpu.metrics.schema import Sample
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def test_expr_nodes_promql_render():
+    assert (
+        Cmp(Aggregate("min", Select("tpu_metrics_exporter_up")), "<", 1).promql()
+        == "min(tpu_metrics_exporter_up) < 1"
+    )
+    assert Absent(Select("x")).promql() == "absent(x)"
+    assert Cmp(Select("y"), ">", 10.5).promql() == "y > 10.5"
+
+
+def test_aggregate_and_cmp_semantics():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    db.append("up", (("node", "a"),), 1.0)
+    db.append("up", (("node", "b"),), 0.0)
+    assert Aggregate("min", Select("up")).evaluate(db)[0].value == 0.0
+    assert Aggregate("max", Select("up")).evaluate(db)[0].value == 1.0
+    assert Aggregate("sum", Select("up")).evaluate(db)[0].value == 1.0
+    assert Cmp(Aggregate("min", Select("up")), "<", 1).evaluate(db) == [
+        Sample(0.0, ())
+    ]
+    assert Cmp(Aggregate("max", Select("up")), "<", 1).evaluate(db) == []
+    assert Absent(Select("nope")).evaluate(db) == [Sample(1.0, ())]
+    assert Absent(Select("up")).evaluate(db) == []
+
+
+def test_alert_for_window_pending_then_firing():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    alert = AlertRule("Up0", Cmp(Select("up"), "<", 1), for_seconds=30.0)
+    db.append("up", (), 0.0)
+    assert alert.evaluate(db) is False  # pending, not yet firing
+    clock.advance(29.0)
+    db.append("up", (), 0.0)
+    assert alert.evaluate(db) is False
+    clock.advance(1.0)
+    db.append("up", (), 0.0)
+    assert alert.evaluate(db) is True  # 30s continuously true
+    # one healthy evaluation resets pending AND firing
+    db.append("up", (), 1.0)
+    assert alert.evaluate(db) is False
+    db.append("up", (), 0.0)
+    assert alert.evaluate(db) is False  # pending restarts from zero
+
+
+def test_exporter_outage_fires_and_clears_in_live_loop():
+    """Exporter dies in a running pipeline: TpuExporterDown needs the exporter
+    to SERVE up=0 (it serves but its source is stale), while a hard outage
+    (target unreachable) kills the series entirely — that is
+    TpuAutoscaleSignalAbsent's job.  Drive the hard-outage path end to end."""
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("n0", 4)], pod_start_latency=12.0)
+    dep = SimDeployment(cluster, "tpu-test", "tpu-test", load_fn=lambda t: 30.0)
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(cluster, dep, target_value=40.0)
+    alerts = pipeline_alert_rules()
+    pipe.evaluator.alerts = alerts
+    pipe.start()
+    clock.advance(30.0)
+    assert pipe.evaluator.firing_alerts() == []
+
+    target = next(t for t in pipe.scraper.targets if t.name == "exporter/n0")
+    original = target.fetch
+    target.fetch = lambda: (_ for _ in ()).throw(ConnectionError("down"))
+    clock.advance(90.0)  # > the 60s for-window
+    assert "TpuAutoscaleSignalAbsent" in pipe.evaluator.firing_alerts()
+
+    target.fetch = original
+    clock.advance(10.0)
+    assert pipe.evaluator.firing_alerts() == []
+
+
+def test_stale_exporter_fires_exporter_down_alert():
+    """The exporter serving with a stale source exports up=0 and a growing
+    sample age — both TpuExporterDown and TpuExporterStale must fire."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    alerts = pipeline_alert_rules()
+    evaluator = RuleEvaluator(db, [], alerts=alerts)
+
+    for t in range(120):
+        db.append("tpu_metrics_exporter_up", (("node", "n0"),), 0.0)
+        db.append(
+            "tpu_metrics_exporter_sample_age_seconds", (("node", "n0"),), 15.0 + t
+        )
+        # the autoscale series is also gone (chip gauges withheld)
+        evaluator.evaluate_once()
+        clock.advance(1.0)
+    firing = set(evaluator.firing_alerts())
+    assert {"TpuExporterDown", "TpuExporterStale", "TpuAutoscaleSignalAbsent"} <= firing
+
+
+def test_shipped_alert_group_matches_asts():
+    from pathlib import Path
+
+    import yaml
+
+    doc = yaml.safe_load(
+        (Path(__file__).parent.parent / "deploy/tpu-test-prometheusrule.yaml").read_text()
+    )
+    groups = {g["name"]: g for g in doc["spec"]["groups"]}
+    shipped = {r["alert"]: r for r in groups["tpu-pipeline-alerts"]["rules"]}
+    for rule in pipeline_alert_rules():
+        assert shipped[rule.alert]["expr"] == rule.expr.promql()
+        assert shipped[rule.alert]["for"] == f"{int(rule.for_seconds)}s"
+        assert shipped[rule.alert]["labels"] == rule.labels

@@ -16,6 +16,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from k8s_gpu_hpa_tpu.metrics.rules import (
+    pipeline_alert_rules,
     tpu_test_avg_rule,
     tpu_test_multihost_avg_rule,
     tpu_test_pod_max_rule,
@@ -122,6 +123,28 @@ def render() -> str:
         "      rules:\n"
     )
     out.append(_render_rule(tpu_test_multihost_avg_rule()))
+    out.append(
+        "    # pipeline health alerts: the joints' silent-breakage modes made\n"
+        "    # loud (the reference ships no alerting; SURVEY.md §1 notes that a\n"
+        "    # broken string contract stops the loop with no error anywhere)\n"
+        "    - name: tpu-pipeline-alerts\n"
+        "      interval: 1s\n"
+        "      rules:\n"
+    )
+    for alert in pipeline_alert_rules():
+        out.append(f"        - alert: {alert.alert}\n")
+        out.append(f"          expr: {alert.expr.promql()}\n")
+        if alert.for_seconds:
+            out.append(f"          for: {int(alert.for_seconds)}s\n")
+        if alert.labels:
+            out.append("          labels:\n")
+            for k, v in alert.labels.items():
+                out.append(f"            {k}: {v}\n")
+        if alert.annotations:
+            out.append("          annotations:\n")
+            for k, v in alert.annotations.items():
+                out.append(f"            {k}: >-\n")
+                out.append(f"              {v}\n")
     return "".join(out)
 
 
