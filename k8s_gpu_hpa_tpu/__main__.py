@@ -121,6 +121,19 @@ def main(argv: list[str] | None = None) -> int:
     gen.add_argument("--namespace", default="default")
     gen.add_argument("-o", "--out", help="directory to write files (default: stdout)")
 
+    sim = sub.add_parser(
+        "simulate",
+        help="play a load scenario against a shipped HPA manifest (virtual time)",
+    )
+    sim.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
+    sim.add_argument(
+        "--scenario",
+        choices=["spike", "ramp", "flap", "outage"],
+        default="spike",
+    )
+    sim.add_argument("--duration", type=float, default=420.0)
+    sim.add_argument("--pod-start", type=float, default=12.0)
+
     genm = sub.add_parser(
         "gen-manifests", help="check or write the generated shipped manifests"
     )
@@ -160,6 +173,10 @@ def main(argv: list[str] | None = None) -> int:
 
         stub_main()
         return 0
+    if args.command == "simulate":
+        from k8s_gpu_hpa_tpu.simulate import main as simulate_main
+
+        return simulate_main(args)
     if args.command == "gen-pipeline":
         return _cmd_gen_pipeline(args)
     if args.command == "gen-manifests":

@@ -1,0 +1,191 @@
+"""Scenario simulator: play a load scenario against a shipped HPA manifest.
+
+The closed-loop simulation the tests and bench use, packaged as an operator
+tool: ``python -m k8s_gpu_hpa_tpu simulate --hpa deploy/tpu-test-hpa.yaml
+--scenario spike`` answers "what will this HPA actually do?" in seconds of
+wall time, before anything touches a cluster.  The reference's only way to
+learn its loop's dynamics is to deploy it and watch (README.md:112-123 — and
+its one documented surprise, the overshoot defect, was discovered that way).
+
+Scenarios (offered load in percent-of-one-chip units; replicas share it):
+
+- ``spike``    — idle, then a step to 8x one chip at t=60: the north-star
+                 scale-up scenario (BASELINE.md).
+- ``ramp``     — linear growth from idle to 8x over 10 minutes.
+- ``flap``     — oscillation around the target: shows tolerance + the
+                 scale-down stabilization window suppressing replica flap.
+- ``outage``   — steady mid load, exporters die at t=120 for 2 minutes:
+                 shows the hold-don't-act failure semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import yaml
+
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import (
+    behavior_from_manifest,
+    metrics_from_manifest,
+    quantum_from_manifest,
+)
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+SCENARIOS = {
+    "spike": lambda t: 800.0 if t >= 60.0 else 20.0,
+    "ramp": lambda t: 20.0 + min(780.0, max(0.0, t - 60.0) * 780.0 / 600.0),
+    "flap": lambda t: 80.0 + 8.0 * math.sin(2 * math.pi * t / 60.0),
+    "outage": lambda t: 120.0,
+}
+
+
+@dataclass
+class SimReport:
+    scenario: str
+    timeline: list[tuple[float, float, float | None, int, int]] = field(
+        default_factory=list
+    )  # (t, offered, recorded, replicas, running)
+    scale_events: list[tuple[float, int, int]] = field(default_factory=list)
+    scale_up_latency: float | None = None  # spike: target-cross -> max replicas
+
+
+def run_scenario(
+    hpa_doc: dict,
+    scenario: str = "spike",
+    duration: float = 420.0,
+    pod_start_latency: float = 12.0,
+    sample_every: float = 5.0,
+) -> SimReport:
+    """Simulate one shipped Object-metric HPA manifest under a load scenario.
+
+    Behavior, bounds, target, and slice quantum all come from the manifest —
+    the same parsing path the tests and bench use (the manifest IS the spec).
+    """
+    load_fn = SCENARIOS[scenario]
+    spec = hpa_doc["spec"]
+    ref = spec["scaleTargetRef"]
+    metrics = metrics_from_manifest(hpa_doc)
+    from k8s_gpu_hpa_tpu.control.hpa import ObjectMetricSpec
+
+    if len(metrics) != 1 or not isinstance(metrics[0], ObjectMetricSpec):
+        raise ValueError(
+            "simulate supports single Object-metric HPAs (the tensorcore "
+            "rungs); got " + ", ".join(type(m).__name__ for m in metrics)
+        )
+    quantum = quantum_from_manifest(hpa_doc)
+
+    clock = VirtualClock()
+    max_replicas = spec["maxReplicas"]
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"tpu-node-{i}", 4) for i in range((max_replicas + 3) // 4 + 1)],
+        pod_start_latency=pod_start_latency,
+    )
+    dep = SimDeployment(
+        cluster,
+        ref["name"],
+        ref["name"],
+        load_fn=load_fn,
+        load_mode="shared",
+        hosts_per_slice=quantum,
+    )
+    cluster.add_deployment(dep, replicas=spec.get("minReplicas", 1))
+    clock.advance(15.0)
+    # scenario time starts NOW: the timeline's t axis and the load function
+    # agree (the 15 s settle above is not part of the scenario)
+    base = clock.now()
+    dep.load_fn = lambda t: load_fn(t - base)
+
+    pipe = AutoscalingPipeline(
+        cluster,
+        dep,
+        record=metrics[0].metric_name,
+        target_value=metrics[0].target_value,
+        min_replicas=spec.get("minReplicas", 1),
+        max_replicas=max_replicas,
+        behavior=behavior_from_manifest(hpa_doc),
+        replica_quantum=quantum,
+        object_kind=ref["kind"],
+    )
+    pipe.start()
+
+    outage_window = (120.0, 240.0) if scenario == "outage" else None
+    originals: list[tuple] = []
+
+    report = SimReport(scenario=scenario)
+    t_cross = None
+    target_value = metrics[0].target_value
+    elapsed = 0.0
+    while elapsed < duration:
+        if outage_window and originals == [] and elapsed >= outage_window[0]:
+            for tgt in pipe.scraper.targets:
+                if tgt.name.startswith("exporter/"):
+                    originals.append((tgt, tgt.fetch))
+                    tgt.fetch = lambda: (_ for _ in ()).throw(
+                        ConnectionError("exporter down (scenario)")
+                    )
+        if outage_window and originals and elapsed >= outage_window[1]:
+            for tgt, fetch in originals:
+                tgt.fetch = fetch
+            outage_window = None
+
+        clock.advance(sample_every)
+        elapsed += sample_every
+        recorded = pipe.db.latest(
+            metrics[0].metric_name, {}
+        )
+        if t_cross is None and recorded is not None and recorded > target_value:
+            t_cross = elapsed
+        report.timeline.append(
+            (
+                elapsed,
+                load_fn(elapsed),
+                recorded,
+                dep.replicas,
+                len(cluster.running_pods(dep.name)),
+            )
+        )
+        if (
+            t_cross is not None
+            and report.scale_up_latency is None
+            and dep.replicas == max_replicas
+            and len(cluster.running_pods(dep.name)) == max_replicas
+        ):
+            report.scale_up_latency = elapsed - t_cross
+
+    report.scale_events = [(ts - base, a, b) for ts, a, b in pipe.scale_history]
+    return report
+
+
+def render_report(report: SimReport) -> str:
+    lines = [
+        f"scenario: {report.scenario}",
+        f"{'t(s)':>6} {'offered%':>9} {'recorded':>9} {'replicas':>9} {'running':>8}",
+    ]
+    for t, offered, recorded, replicas, running in report.timeline:
+        rec = f"{recorded:.1f}" if recorded is not None else "absent"
+        lines.append(f"{t:>6.0f} {offered:>9.1f} {rec:>9} {replicas:>9} {running:>8}")
+    lines.append("")
+    for ts, a, b in report.scale_events:
+        lines.append(f"scale event t={ts:.0f}s: {a} -> {b}")
+    if report.scale_up_latency is not None:
+        lines.append(
+            f"scale-up latency (signal crossing -> all replicas running): "
+            f"{report.scale_up_latency:.0f}s"
+        )
+    return "\n".join(lines)
+
+
+def main(args) -> int:
+    hpa_doc = yaml.safe_load(open(args.hpa).read())
+    report = run_scenario(
+        hpa_doc,
+        scenario=args.scenario,
+        duration=args.duration,
+        pod_start_latency=args.pod_start,
+    )
+    print(render_report(report))
+    return 0

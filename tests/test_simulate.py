@@ -1,0 +1,65 @@
+"""The scenario simulator CLI: shipped manifests played against load shapes."""
+
+from pathlib import Path
+
+import pytest
+import yaml
+
+from k8s_gpu_hpa_tpu.__main__ import main
+from k8s_gpu_hpa_tpu.simulate import run_scenario
+
+DEPLOY = Path(__file__).parent.parent / "deploy"
+
+
+def load_hpa(name="tpu-test-hpa.yaml"):
+    return yaml.safe_load((DEPLOY / name).read_text())
+
+
+def test_spike_scenario_meets_north_star_budget():
+    report = run_scenario(load_hpa(), scenario="spike", duration=240.0)
+    assert report.scale_up_latency is not None
+    assert report.scale_up_latency <= 60.0  # BASELINE.md budget
+    assert report.timeline[-1][3] == 4  # at max replicas
+    # timeline t axis and load agree: the spike lands at t=60
+    by_t = {t: offered for t, offered, *_ in report.timeline}
+    assert by_t[55.0] < 100 < by_t[65.0]
+
+
+def test_flap_scenario_does_not_flap_replicas():
+    report = run_scenario(load_hpa(), scenario="flap", duration=600.0)
+    # at most the initial settle event; no oscillating up/down pairs
+    assert len(report.scale_events) <= 2
+
+
+def test_outage_scenario_holds_then_recovers():
+    report = run_scenario(load_hpa(), scenario="outage", duration=360.0)
+    during = [rec for t, _, rec, *_ in report.timeline if 130.0 <= t <= 230.0]
+    assert all(rec is None for rec in during), "signal must be absent in outage"
+    replicas_during = {r for t, _, _, r, _ in report.timeline if 130.0 <= t <= 230.0}
+    assert len(replicas_during) == 1, "must hold replicas during the outage"
+    after = [rec for t, _, rec, *_ in report.timeline if t >= 260.0]
+    assert after and all(rec is not None for rec in after), "must recover"
+
+
+def test_multihost_manifest_scales_by_slices():
+    report = run_scenario(
+        load_hpa("tpu-test-multihost-hpa.yaml"), scenario="spike", duration=300.0
+    )
+    for _, _, _, replicas, _ in report.timeline:
+        assert replicas % 2 == 0, "quantum from the manifest must hold"
+
+
+def test_rejects_non_object_manifests():
+    with pytest.raises(ValueError, match="Object-metric"):
+        run_scenario(load_hpa("tpu-test-hbm-hpa.yaml"))
+
+
+def test_cli_prints_timeline(capsys):
+    rc = main(
+        ["simulate", "--hpa", str(DEPLOY / "tpu-test-hpa.yaml"), "--duration", "180"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "scenario: spike" in out
+    assert "scale event" in out
+    assert "scale-up latency" in out
