@@ -193,11 +193,15 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         set(CHIP_METRICS)
         | recorded
         | {
-            "tpu_metrics_exporter_up",  # exporter self-metric (cpp/exporter)
+            # exporter self-metrics (cpp/exporter)
+            "tpu_metrics_exporter_up",
+            "tpu_metrics_exporter_sample_age_seconds",
             # kube-state-metrics series from the stack install
             "kube_horizontalpodautoscaler_status_current_replicas",
             "kube_horizontalpodautoscaler_status_desired_replicas",
             "kube_pod_labels",
+            # Prometheus' own alert-state series (the alerts panel)
+            "ALERTS",
         }
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
@@ -206,7 +210,7 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         names = {
             tok
             for tok in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr)
-            if tok.startswith(("tpu_", "kube_"))
+            if tok.startswith(("tpu_", "kube_", "ALERTS"))
         }
         assert names, f"no metric reference in {expr!r}"
         assert names <= known, f"unknown series in {expr!r}: {names - known}"

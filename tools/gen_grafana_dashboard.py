@@ -216,6 +216,61 @@ def build_dashboard() -> dict:
             },
             "targets": [_target("min(tpu_metrics_exporter_up)", "exporters up", "A")],
         },
+        _ts_panel(
+            7,
+            "Exporter sample age per node",
+            0,
+            24,
+            [
+                _target(
+                    "max by(node) (tpu_metrics_exporter_sample_age_seconds)",
+                    "{{node}}",
+                    "A",
+                )
+            ],
+            "Age of each exporter's newest chip reading.  The red line is the "
+            "TpuExporterStale alert threshold (10s): above it the collect "
+            "loop is wedged or libtpu is unresponsive.",
+            unit="s",
+            threshold=10,
+        ),
+        {
+            "id": 8,
+            "type": "stat",
+            "title": "Pipeline alerts firing",
+            "description": "Count of firing tpu-pipeline-alerts "
+            "(TpuExporterDown / TpuExporterStale / TpuAutoscaleSignalAbsent "
+            "— deploy/tpu-test-prometheusrule.yaml).  0 means every joint of "
+            "the loop is live.",
+            "gridPos": {"h": 8, "w": 12, "x": 12, "y": 24},
+            "datasource": {"type": "prometheus", "uid": "${datasource}"},
+            "fieldConfig": {
+                "defaults": {
+                    "thresholds": {
+                        "mode": "absolute",
+                        "steps": [
+                            {"color": "green", "value": None},
+                            {"color": "red", "value": 1},
+                        ],
+                    },
+                },
+                "overrides": [],
+            },
+            "options": {
+                "colorMode": "background",
+                "graphMode": "none",
+                "reduceOptions": {"calcs": ["lastNotNull"]},
+                "textMode": "value_and_name",
+            },
+            "targets": [
+                _target(
+                    'count(ALERTS{alertname=~"Tpu.+",alertstate="firing"}) '
+                    "or vector(0)",
+                    "firing",
+                    "A",
+                )
+            ],
+        },
     ]
     return {
         "title": "TPU HPA pipeline",
