@@ -135,12 +135,30 @@ def check_hpa_status(payload: str) -> str:
     return f"ScalingActive, replicas current={cur} desired={des}"
 
 
+def check_alerts(payload: str) -> str:
+    """Post-probe: Prometheus' alert view of the pipeline (``/api/v1/alerts``).
+    A firing Tpu* alert is a diagnosis even when every joint answered its
+    probe — e.g. a single node's exporter down in a multi-node fleet degrades
+    coverage without failing the L2 probe against another node."""
+    doc = json.loads(payload)
+    firing = sorted(
+        a["labels"].get("alertname", "?")
+        for a in doc.get("data", {}).get("alerts", [])
+        if a.get("state") == "firing"
+        and a["labels"].get("alertname", "").startswith("Tpu")
+    )
+    if firing:
+        raise AssertionError(f"pipeline alerts firing: {', '.join(firing)}")
+    return "no pipeline alerts firing"
+
+
 def diagnose(
     exporter_fetch: Callable[[], str] | None = None,
     prom_fetch: Callable[[], str] | None = None,
     api_fetch: Callable[[], str] | None = None,
     hpa_fetch: Callable[[], str] | None = None,
     metric: str = "tpu_test_tensorcore_avg",
+    alerts_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -169,6 +187,11 @@ def diagnose(
             "L5 HPA",
             "HPA is reading the metric (ScalingActive)",
             (lambda: check_hpa_status(hpa_fetch())) if hpa_fetch else None,
+        ),
+        (
+            "alerts",
+            "no tpu-pipeline-alerts firing",
+            (lambda: check_alerts(alerts_fetch())) if alerts_fetch else None,
         ),
     ]
     results: list[ProbeResult] = []
@@ -234,6 +257,7 @@ def main() -> int:
             else None
         ),
         metric=metric,
+        alerts_fetch=lambda: _http_fetch(f"{prom_url}/api/v1/alerts"),
     )
     broken = False
     for r in results:

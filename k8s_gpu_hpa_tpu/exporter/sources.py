@@ -126,6 +126,9 @@ class JaxDeviceSource:
 LIBTPU_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
 LIBTPU_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
 LIBTPU_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+# Served by newer libtpu builds only; LibtpuSource degrades to 0 (and stops
+# asking) when the runtime answers with an error for this name.
+LIBTPU_HBM_BW = "tpu.runtime.hbm.bandwidth.utilization.percent"
 
 
 def parse_metric_response(data: bytes) -> dict[int, float]:
@@ -178,6 +181,8 @@ class LibtpuSource:
     address: str = "localhost:8431"
     timeout: float = 3.0
     _channel: object = field(default=None, repr=False)
+    #: None = untested; probed on the first sweep, sticky afterwards
+    _bw_supported: bool | None = field(default=None, repr=False)
 
     def _get_metric(self, name: str) -> dict[int, float]:
         call = self._channel.unary_unary(
@@ -205,6 +210,16 @@ class LibtpuSource:
         except Exception:
             self.close()  # drop a possibly-wedged channel; reconnect next sweep
             raise
+        bw: dict[int, float] = {}
+        if self._bw_supported is not False:
+            # newer libtpu only: one failed probe marks it unsupported for the
+            # daemon's lifetime (don't pay a failing RPC every sweep), but a
+            # failure here must not discard the sweep we already have
+            try:
+                bw = self._get_metric(LIBTPU_HBM_BW)
+                self._bw_supported = True
+            except Exception:
+                self._bw_supported = False
         chips = []
         for device_id in sorted(set(duty) | set(usage) | set(total)):
             d = duty.get(device_id, 0.0)
@@ -215,7 +230,7 @@ class LibtpuSource:
                     duty_cycle=d,
                     hbm_usage_bytes=usage.get(device_id, 0.0),
                     hbm_total_bytes=total.get(device_id, 0.0),
-                    hbm_bw_util=0.0,  # not exposed by all libtpu versions
+                    hbm_bw_util=bw.get(device_id, 0.0),
                 )
             )
         return chips

@@ -149,7 +149,7 @@ def test_diagnose_stops_at_first_broken_joint():
 
 def test_diagnose_skips_absent_fetchers():
     results = diagnose(exporter_fetch=lambda: exposition())
-    assert [r.ok for r in results] == [True, True, True, True]
+    assert [r.ok for r in results] == [True, True, True, True, True]
     assert results[1].detail.startswith("skipped")
 
 
@@ -180,3 +180,36 @@ def test_diagnose_against_live_native_exporter():
         assert "2 attributed" in results[0].detail
     finally:
         daemon.close()
+
+
+def test_alerts_probe_reports_firing_tpu_alerts():
+    import json
+
+    from k8s_gpu_hpa_tpu.doctor import check_alerts, diagnose
+
+    quiet = json.dumps({"data": {"alerts": []}})
+    assert check_alerts(quiet) == "no pipeline alerts firing"
+    # non-Tpu alerts (e.g. the stack's own Watchdog) are not a diagnosis
+    other = json.dumps(
+        {"data": {"alerts": [{"state": "firing", "labels": {"alertname": "Watchdog"}}]}}
+    )
+    assert check_alerts(other) == "no pipeline alerts firing"
+    firing = json.dumps(
+        {
+            "data": {
+                "alerts": [
+                    {"state": "firing", "labels": {"alertname": "TpuExporterDown"}},
+                    {"state": "pending", "labels": {"alertname": "TpuExporterStale"}},
+                ]
+            }
+        }
+    )
+    try:
+        check_alerts(firing)
+        raise AssertionError("should have raised")
+    except AssertionError as e:
+        assert "TpuExporterDown" in str(e)
+        assert "TpuExporterStale" not in str(e)  # pending is not firing
+
+    results = diagnose(alerts_fetch=lambda: firing)
+    assert results[-1].name == "alerts" and not results[-1].ok

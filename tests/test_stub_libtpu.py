@@ -55,11 +55,15 @@ def test_source_reads_stub_over_grpc():
     assert chips[0].tensorcore_util == 30.0
     assert chips[1].duty_cycle == 90.0
     assert chips[0].hbm_usage_bytes == 8e9
-    # one GetRuntimeMetric per metric per sweep
+    # one GetRuntimeMetric per metric per sweep (bandwidth probed too on the
+    # first sweep; see test_hbm_bandwidth_* for its degradation path)
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
     assert server.request_log == [
         LIBTPU_DUTY_CYCLE,
         LIBTPU_HBM_USAGE,
         LIBTPU_HBM_TOTAL,
+        LIBTPU_HBM_BW,
     ]
 
 
@@ -103,3 +107,43 @@ def test_daemon_serves_stub_libtpu_metrics_over_http():
     usage = {s.label("chip"): s.value for s in fams[TPU_HBM_USAGE].samples}
     assert usage == {"0": 8e9, "1": 8e9}
     assert 'tpu_metrics_exporter_up{node="tpu-node-0"} 1' in body
+
+
+def test_hbm_bandwidth_served_when_supported():
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
+    with StubLibtpuServer(
+        num_chips=2,
+        metric_fn=lambda name, i: 37.5 if name == LIBTPU_HBM_BW else 50.0,
+    ) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert [c.hbm_bw_util for c in chips] == [37.5, 37.5]
+            assert source._bw_supported is True
+        finally:
+            source.close()
+
+
+def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
+    """Older libtpu: the bandwidth metric errors.  The sweep must survive
+    (bw=0), and the failing RPC must not be retried every second."""
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
+    def metric_fn(name, i):
+        if name == LIBTPU_HBM_BW:
+            raise KeyError(f"unknown metric {name}")
+        return 50.0
+
+    with StubLibtpuServer(num_chips=2, metric_fn=metric_fn) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert len(chips) == 2
+            assert all(c.hbm_bw_util == 0.0 for c in chips)
+            assert all(c.duty_cycle == 50.0 for c in chips)
+            assert source._bw_supported is False
+            source.sample()
+            assert server.request_log.count(LIBTPU_HBM_BW) == 1  # sticky
+        finally:
+            source.close()
