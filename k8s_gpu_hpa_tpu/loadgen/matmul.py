@@ -74,6 +74,7 @@ class MatmulLoadGen:
         use_pallas: bool = False,
         device=None,
         window: float = 10.0,
+        all_devices: bool | None = None,
     ):
         self.size = size
         if iters_per_burst is None:
@@ -81,16 +82,24 @@ class MatmulLoadGen:
             # round-trip overhead; on CPU keep tests fast.
             iters_per_burst = 256 if jax.default_backend() == "tpu" else 4
         self.iters_per_burst = iters_per_burst
-        self.device = device or jax.devices()[0]
+        # Multi-chip pods (the v5e-8 rung: one pod owns the whole single-host
+        # slice, tpu-test-v5e8-deployment.yaml) must load EVERY chip they
+        # own — a single-device busy-loop would leave 7 of 8 chips idle and
+        # the per-pod "hottest chip" signal honest but the capacity story
+        # wrong.  The batch dimension is sharded over the chips; each chip
+        # runs its own matmul chain, no collectives (the reference's
+        # isolated-replica load shape, SPMD inside one pod).
+        if all_devices is None:
+            all_devices = device is None
+        self._devices = (
+            jax.local_devices() if all_devices else [device or jax.devices()[0]]
+        )
+        self.n_devices = len(self._devices)
+        self.device = self._devices[0]
         self.window = window
         self.knob = IntensityKnob(intensity)
         self.peak_tflops = peak_tflops_for(self.device)
         key = jax.random.PRNGKey(0)
-        with jax.default_device(self.device):
-            self._a = jax.random.normal(key, (size, size), dtype=dtype)
-            self._b = jax.random.normal(
-                jax.random.fold_in(key, 1), (size, size), dtype=dtype
-            )
 
         # Default hot op: XLA's dot with f32 accumulation — measured fastest
         # on v5e (~165 TFLOP/s best, consistently ahead of both the bf16-acc
@@ -104,6 +113,37 @@ class MatmulLoadGen:
             ).astype(a.dtype)
         )
 
+        if self.n_devices > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(self._devices, ("chips",))
+            self._a = jax.device_put(
+                jax.random.normal(key, (self.n_devices, size, size), dtype=dtype),
+                NamedSharding(mesh, P("chips")),
+            )
+            self._b = jax.device_put(
+                jax.random.normal(jax.random.fold_in(key, 1), (size, size), dtype),
+                NamedSharding(mesh, P()),
+            )
+
+            def body_op(x, b):
+                # batch dim sharded one-per-chip: XLA runs independent
+                # per-chip matmuls, zero collectives
+                y = jnp.einsum(
+                    "bij,jk->bik", x, b, preferred_element_type=jnp.float32
+                ).astype(x.dtype)
+                return y
+
+        else:
+            with jax.default_device(self.device):
+                self._a = jax.random.normal(key, (size, size), dtype=dtype)
+                self._b = jax.random.normal(
+                    jax.random.fold_in(key, 1), (size, size), dtype=dtype
+                )
+
+            def body_op(x, b):
+                return inner(x, b)
+
         def burst(a, b):
             # Chain matmuls so one dispatch keeps the MXU busy for the whole
             # burst; normalization keeps values from overflowing bf16.  The
@@ -111,14 +151,14 @@ class MatmulLoadGen:
             # even on backends whose block_until_ready does not actually block
             # (remote-tunnel platforms), and transfers 4 bytes, not the matrix.
             def body(_, x):
-                y = inner(x, b)
+                y = body_op(x, b)
                 return y * (1.0 / jnp.sqrt(jnp.float32(self.size)).astype(y.dtype))
 
             out = lax.fori_loop(0, self.iters_per_burst, body, a)
-            return out[0, 0].astype(jnp.float32)
+            return out.ravel()[0].astype(jnp.float32)
 
         self._burst = jax.jit(burst)
-        self._tiny = jax.jit(lambda a: (a * 2)[0, 0].astype(jnp.float32))
+        self._tiny = jax.jit(lambda a: (a * 2).ravel()[0].astype(jnp.float32))
         self._rtt = 0.0  # measured dispatch+readback floor, set by warmup()
         self._history: list[tuple[float, float, float]] = []  # (t, busy, flops)
         self._steps = 0
@@ -171,7 +211,7 @@ class MatmulLoadGen:
         t0 = time.perf_counter()
         float(self._burst(self._a, self._b))  # scalar fetch forces completion
         busy = time.perf_counter() - t0
-        flops = 2.0 * self.size**3 * self.iters_per_burst
+        flops = 2.0 * self.size**3 * self.iters_per_burst * self.n_devices
         self._record(busy, flops)
         self._steps += 1
         self.knob.throttle(busy)  # duty cycle: busy/(busy+idle) = intensity

@@ -154,3 +154,37 @@ def test_train_loadgen_respects_mesh_model_axis():
     gen = TrainLoadGen(mesh=mesh, batch_size=8, image_size=8, small=True)
     gen.step()
     assert gen.stats().steps == 1
+
+
+def test_matmul_loadgen_loads_every_local_device():
+    """The v5e-8 rung's pod owns all 8 chips; the default generator must
+    shard its batch one-per-chip (no chip left idle) and account FLOPs for
+    all of them."""
+    import jax
+
+    gen = MatmulLoadGen(size=128, iters_per_burst=1, intensity=1.0)
+    assert gen.n_devices == len(jax.local_devices()) == 8
+    # the operand batch is sharded one slice per device
+    assert len({d for d in gen._a.devices()}) == 8
+    gen.warmup()
+    gen.step()
+    stats = gen.stats()
+    assert stats.steps == 1
+    # 8x the single-device FLOPs per burst
+    single = MatmulLoadGen(
+        size=128, iters_per_burst=1, intensity=1.0, all_devices=False
+    )
+    single.warmup()
+    single.step()
+    assert stats.busy_seconds > 0
+    # flops accounting: multi-device records 8x per burst
+    multi_flops = sum(f for _, _, f in gen._history)
+    single_flops = sum(f for _, _, f in single._history)
+    assert multi_flops == 8 * single_flops
+
+
+def test_matmul_loadgen_single_device_when_pinned():
+    import jax
+
+    gen = MatmulLoadGen(size=128, device=jax.devices()[0])
+    assert gen.n_devices == 1
