@@ -120,9 +120,11 @@ def main() -> None:
         attributor = None
     else:
         from k8s_gpu_hpa_tpu.exporter.podresources import PodResourcesClient
-        from k8s_gpu_hpa_tpu.exporter.sources import LibtpuSource
+        from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
 
-        source = LibtpuSource()
+        # every runtime-metrics port on the node (TPU_RUNTIME_METRICS_PORTS,
+        # one per TPU workload process; defaults to the single 8431)
+        source = MergedLibtpuSource.from_env()
         attributor = PodResourcesClient()
 
     daemon = ExporterDaemon(

@@ -170,6 +170,71 @@ def parse_metric_response(data: bytes) -> dict[int, float]:
 
 
 @dataclass
+class MergedLibtpuSource:
+    """All libtpu runtime-metrics endpoints of one node, merged.
+
+    GKE runs one runtime-metrics server per TPU *workload process*, so a node
+    hosting several single-chip pods (1x1 topology on a v5e-8 host) has
+    several ports — the ``TPU_RUNTIME_METRICS_PORTS`` env GKE injects; the
+    exporter (hostNetwork) must read all of them or it only sees one pod's
+    chips.  Per-port failures are per-pod lifecycle (a pod exiting mid-sweep),
+    so they drop that port's chips for the sweep rather than failing it; only
+    ALL ports failing raises (node-level outage -> the daemon's freshness
+    watchdog flips ``up``).  Chip-id collisions (two processes claiming one
+    chip during pod churn) resolve to the busier reading.
+    """
+
+    addresses: list[str] = field(default_factory=lambda: ["localhost:8431"])
+    timeout: float = 3.0
+    _sources: list["LibtpuSource"] = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self._sources is None:
+            self._sources = [
+                LibtpuSource(address=a, timeout=self.timeout)
+                for a in self.addresses
+            ]
+
+    @staticmethod
+    def from_env(env: dict | None = None) -> "MergedLibtpuSource":
+        """Addresses from TPU_RUNTIME_METRICS_PORTS ("8431,8432,..."), the
+        GKE convention; default single 8431."""
+        import os as _os
+
+        env = _os.environ if env is None else env
+        ports = [
+            p.strip()
+            for p in env.get("TPU_RUNTIME_METRICS_PORTS", "8431").split(",")
+            if p.strip()
+        ]
+        return MergedLibtpuSource(addresses=[f"localhost:{p}" for p in ports])
+
+    def sample(self) -> list[ChipSample]:
+        merged: dict[int, ChipSample] = {}
+        errors = []
+        for source in self._sources:
+            try:
+                chips = source.sample()
+            except Exception as e:
+                errors.append((source.address, e))
+                continue
+            for chip in chips:
+                seen = merged.get(chip.accel_index)
+                if seen is None or chip.duty_cycle > seen.duty_cycle:
+                    merged[chip.accel_index] = chip
+        if errors and not merged:
+            raise ConnectionError(
+                "all libtpu endpoints failed: "
+                + "; ".join(f"{a}: {e}" for a, e in errors)
+            )
+        return [merged[i] for i in sorted(merged)]
+
+    def close(self) -> None:
+        for source in self._sources:
+            source.close()
+
+
+@dataclass
 class LibtpuSource:
     """gRPC client of the libtpu runtime-metrics service (production path).
 

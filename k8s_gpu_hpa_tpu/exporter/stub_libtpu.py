@@ -76,6 +76,9 @@ class StubLibtpuServer:
     hbm_total: float = 16e9
     request_log: list[str] = field(default_factory=list)
     port: int = 0
+    #: explicit global chip ids (default range(num_chips)) — lets tests model
+    #: several per-process servers each owning different chips of one host
+    device_ids: list[int] | None = None
 
     def _value(self, name: str, device_id: int) -> float:
         if self.metric_fn is not None:
@@ -91,7 +94,8 @@ class StubLibtpuServer:
     def _handle(self, request: bytes, context) -> bytes:
         name = decode_metric_request(request)
         self.request_log.append(name)
-        per_device = {i: self._value(name, i) for i in range(self.num_chips)}
+        ids = self.device_ids or list(range(self.num_chips))
+        per_device = {i: self._value(name, i) for i in ids}
         # libtpu reports HBM byte counts as int64 gauges, percentages as
         # doubles; serve both encodings so the client's dual decode is covered.
         as_int = name in (sources.LIBTPU_HBM_USAGE, sources.LIBTPU_HBM_TOTAL)

@@ -240,6 +240,10 @@ def exporter_daemonset(accelerator: str = ACCEL_V5E) -> dict:
                             "command": ["python", "-m", "k8s_gpu_hpa_tpu.exporter"],
                             "env": [
                                 {"name": "SOURCE", "value": "libtpu"},
+                                {
+                                    "name": "TPU_RUNTIME_METRICS_PORTS",
+                                    "value": "8431",
+                                },
                                 {"name": "LISTEN_PORT", "value": str(EXPORTER_PORT)},
                                 {"name": "COLLECT_MS", "value": "1000"},
                                 {

@@ -147,3 +147,70 @@ def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
             assert server.request_log.count(LIBTPU_HBM_BW) == 1  # sticky
         finally:
             source.close()
+
+
+def test_merged_source_unions_per_process_servers():
+    """A node with several TPU pods runs one runtime-metrics server per
+    process; the merged source must see every pod's chips."""
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    with StubLibtpuServer(num_chips=2, device_ids=[0, 1]) as s1, StubLibtpuServer(
+        num_chips=2, device_ids=[2, 3]
+    ) as s2:
+        source = MergedLibtpuSource(addresses=[s1.address, s2.address])
+        try:
+            chips = source.sample()
+            assert [c.accel_index for c in chips] == [0, 1, 2, 3]
+        finally:
+            source.close()
+
+
+def test_merged_source_survives_one_dead_port():
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    with StubLibtpuServer(num_chips=2, device_ids=[0, 1]) as s1:
+        dead = "localhost:1"  # nothing listens there
+        source = MergedLibtpuSource(addresses=[s1.address, dead], timeout=0.5)
+        try:
+            chips = source.sample()
+            assert [c.accel_index for c in chips] == [0, 1]
+        finally:
+            source.close()
+
+
+def test_merged_source_raises_when_all_ports_dead():
+    import pytest as _pytest
+
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    source = MergedLibtpuSource(addresses=["localhost:1"], timeout=0.5)
+    with _pytest.raises(ConnectionError, match="all libtpu endpoints failed"):
+        source.sample()
+    source.close()
+
+
+def test_merged_source_collision_prefers_busier_reading():
+    """During pod churn two processes may briefly claim one chip id; the
+    busier reading (the live owner) wins."""
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    with StubLibtpuServer(
+        num_chips=1, device_ids=[0], metric_fn=lambda n, i: 5.0
+    ) as idle, StubLibtpuServer(
+        num_chips=1, device_ids=[0], metric_fn=lambda n, i: 80.0
+    ) as busy:
+        source = MergedLibtpuSource(addresses=[idle.address, busy.address])
+        try:
+            chips = source.sample()
+            assert len(chips) == 1 and chips[0].duty_cycle == 80.0
+        finally:
+            source.close()
+
+
+def test_merged_source_from_env_parses_gke_ports():
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    source = MergedLibtpuSource.from_env({"TPU_RUNTIME_METRICS_PORTS": "8431, 8432"})
+    assert source.addresses == ["localhost:8431", "localhost:8432"]
+    default = MergedLibtpuSource.from_env({})
+    assert default.addresses == ["localhost:8431"]
