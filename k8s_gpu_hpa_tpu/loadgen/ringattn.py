@@ -8,11 +8,13 @@ so it plugs into the exporter/HPA pipeline unchanged.  Selectable in the
 multi-host container via ``WORKLOAD=ringattn`` (loadgen/multihost.py).
 
 Measured on v5e (b=1, ctx=8k, h=8, d=128): ~10 TFLOP/s busy-time regardless
-of kv chunking or layout — XLA-compiled flash attention at these shapes is
-VPU/softmax-bound, not MXU-bound (the matmul generator is the MXU-saturation
-rung; this one exists for the attention+ICI *profile*).  A Pallas flash
-kernel is the known next step if raw attention throughput ever becomes the
-goal.
+of kv chunking or layout — and the stock Pallas flash kernel
+(jax.experimental.pallas.ops.tpu.flash_attention) measures the IDENTICAL
+10.4 TFLOP/s at these shapes, so the XLA-level implementation here is at
+hand-written-kernel parity: attention at this batch/head count is
+VPU/softmax-bound on this chip, not implementation-bound.  The matmul
+generator is the MXU-saturation rung; this one exists for the
+attention+ICI *profile*.
 """
 
 from __future__ import annotations
