@@ -44,6 +44,9 @@ def _cmd_gen_pipeline(args: argparse.Namespace) -> int:
         topology=args.topology,
         accelerator=args.accelerator,
         namespace=args.namespace,
+        hosts_per_slice=args.hosts_per_slice,
+        min_slices=args.min_slices,
+        max_slices=args.max_slices,
     )
     files = manifests.render_pipeline(spec)
     if args.out:
@@ -119,6 +122,15 @@ def main(argv: list[str] | None = None) -> int:
     gen.add_argument("--topology", default="1x1")
     gen.add_argument("--accelerator", default="tpu-v5-lite-podslice")
     gen.add_argument("--namespace", default="default")
+    gen.add_argument(
+        "--hosts-per-slice",
+        type=int,
+        default=1,
+        help=">1 renders the multi-host shape: StatefulSet-of-slices + "
+        "headless service + slice-quantum HPA",
+    )
+    gen.add_argument("--min-slices", type=int, default=1)
+    gen.add_argument("--max-slices", type=int, default=4)
     gen.add_argument("-o", "--out", help="directory to write files (default: stdout)")
 
     sim = sub.add_parser(

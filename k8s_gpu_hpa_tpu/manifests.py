@@ -539,27 +539,34 @@ def _tpu_train_deployment() -> dict:
     )
 
 
-def _multihost_service() -> dict:
+def multihost_service(name: str = "tpu-test-multihost") -> dict:
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {
-            "name": "tpu-test-multihost",
-            "labels": {"app": "tpu-test-multihost"},
+            "name": name,
+            "labels": {"app": name},
         },
         "spec": {
             # the literal string "None" is the k8s headless-service sentinel;
             # a YAML null here would be rejected by the apiserver
             "clusterIP": "None",
             "publishNotReadyAddresses": True,
-            "selector": {"app": "tpu-test-multihost"},
+            "selector": {"app": name},
             "ports": [{"name": "coordinator", "port": COORDINATOR_PORT}],
         },
     }
 
 
-def _multihost_statefulset() -> dict:
-    name = "tpu-test-multihost"
+def multihost_statefulset(
+    name: str = "tpu-test-multihost",
+    *,
+    hosts_per_slice: int = 2,
+    tpu_limit: int = 4,
+    topology: str = "2x2x2",
+    accelerator: str = ACCEL_V5P,
+    intensity: str = "0.5",
+) -> dict:
     return {
         "apiVersion": "apps/v1",
         "kind": "StatefulSet",
@@ -572,8 +579,8 @@ def _multihost_statefulset() -> dict:
                 "metadata": {"labels": {"app": name}},
                 "spec": {
                     "nodeSelector": {
-                        NODE_SELECTOR_ACCEL: ACCEL_V5P,
-                        NODE_SELECTOR_TOPO: "2x2x2",
+                        NODE_SELECTOR_ACCEL: accelerator,
+                        NODE_SELECTOR_TOPO: topology,
                     },
                     "tolerations": tpu_tolerations(),
                     "containers": [
@@ -586,7 +593,10 @@ def _multihost_statefulset() -> dict:
                                 "k8s_gpu_hpa_tpu.loadgen.multihost",
                             ],
                             "env": [
-                                {"name": "HOSTS_PER_SLICE", "value": "2"},
+                                {
+                                    "name": "HOSTS_PER_SLICE",
+                                    "value": str(hosts_per_slice),
+                                },
                                 {"name": "HEADLESS_SERVICE", "value": name},
                                 {
                                     "name": "POD_NAMESPACE",
@@ -597,7 +607,7 @@ def _multihost_statefulset() -> dict:
                                     },
                                 },
                                 {"name": "BUFFER_MB", "value": "64"},
-                                {"name": "TPU_TEST_INTENSITY", "value": "0.5"},
+                                {"name": "TPU_TEST_INTENSITY", "value": intensity},
                                 {
                                     "name": "TPU_TEST_INTENSITY_FILE",
                                     "value": INTENSITY_FILE,
@@ -609,7 +619,7 @@ def _multihost_statefulset() -> dict:
                                     "containerPort": COORDINATOR_PORT,
                                 }
                             ],
-                            "resources": {"limits": {TPU_RESOURCE: 4}},
+                            "resources": {"limits": {TPU_RESOURCE: tpu_limit}},
                         }
                     ],
                 },
@@ -782,7 +792,7 @@ def default_bundle() -> dict[str, list[dict]]:
                 ],
             )
         ],
-        "tpu-test-multihost.yaml": [_multihost_service(), _multihost_statefulset()],
+        "tpu-test-multihost.yaml": [multihost_service(), multihost_statefulset()],
         "tpu-test-multihost-hpa.yaml": [
             hpa_manifest(
                 "tpu-test-multihost",
@@ -889,6 +899,12 @@ class PipelineSpec:
     command: list[str] = field(
         default_factory=lambda: ["python", "-m", "k8s_gpu_hpa_tpu.loadgen"]
     )
+    #: >1 renders the multi-host shape: StatefulSet-of-slices + headless
+    #: service + slice-quantum HPA (one logical replica = this many pods)
+    hosts_per_slice: int = 1
+    #: slices at min/max for the multi-host shape (pods = slices * hosts)
+    min_slices: int = 1
+    max_slices: int = 4
 
     def __post_init__(self) -> None:
         import re
@@ -916,7 +932,19 @@ class PipelineSpec:
         stem = METRIC_STEMS[self.device_metric]
         return f"{self.app.replace('-', '_')}_{stem}_avg"
 
+    @property
+    def multihost(self) -> bool:
+        return self.hosts_per_slice > 1
+
     def recording_rule(self) -> RecordingRule:
+        if self.multihost:
+            return tpu_test_multihost_avg_rule(
+                app=self.app,
+                statefulset=self.app,
+                namespace=self.namespace,
+                metric=self.device_metric,
+                record=self.record,
+            )
         return tpu_test_avg_rule(
             app=self.app,
             deployment=self.app,
@@ -927,12 +955,77 @@ class PipelineSpec:
 
 
 def render_pipeline(spec: PipelineSpec) -> dict[str, list[dict]]:
-    """filename -> docs for the four app-specific artifacts of one pipeline.
+    """filename -> docs for the app-specific artifacts of one pipeline.
 
     The shared layers (exporter DaemonSet, Prometheus stack values) are
     app-independent and come from ``default_bundle()``; the adapter values
     here carry only this pipeline's rule — merge into an existing adapter
-    config when running several pipelines side by side."""
+    config when running several pipelines side by side.
+
+    ``hosts_per_slice > 1`` renders the multi-host shape instead: headless
+    Service + StatefulSet-of-slices workload, the rule addressed at the
+    StatefulSet, and a slice-quantum HPA (pair it with
+    deploy/quantum-operator.yaml on a vanilla cluster)."""
+    if spec.multihost:
+        q = spec.hosts_per_slice
+        return {
+            f"{spec.app}-statefulset.yaml": [
+                multihost_service(spec.app),
+                multihost_statefulset(
+                    spec.app,
+                    hosts_per_slice=q,
+                    tpu_limit=spec.tpu_limit,
+                    topology=spec.topology,
+                    accelerator=spec.accelerator,
+                    intensity=spec.intensity,
+                ),
+            ],
+            f"{spec.app}-prometheusrule.yaml": [
+                prometheusrule_manifest(
+                    spec.app, groups=[(spec.app, [spec.recording_rule()])]
+                )
+            ],
+            f"{spec.app}-adapter-values.yaml": [
+                adapter_values(
+                    [adapter_rule(spec.record, resource="statefulset")],
+                    external_rules=[],
+                )
+            ],
+            f"{spec.app}-hpa.yaml": [
+                hpa_manifest(
+                    spec.app,
+                    target_kind="StatefulSet",
+                    metrics=[
+                        object_metric(
+                            spec.record, "StatefulSet", spec.app, spec.target
+                        )
+                    ],
+                    min_replicas=spec.min_slices * q,
+                    max_replicas=spec.max_slices * q,
+                    annotations={"k8s-tpu-hpa/replica-quantum": str(q)},
+                    behavior={
+                        "scaleUp": {
+                            "stabilizationWindowSeconds": 0,
+                            "selectPolicy": "Max",
+                            "policies": [
+                                {
+                                    "type": "Pods",
+                                    "value": 2 * q,
+                                    "periodSeconds": 15,
+                                }
+                            ],
+                        },
+                        "scaleDown": {
+                            "stabilizationWindowSeconds": 120,
+                            "selectPolicy": "Max",
+                            "policies": [
+                                {"type": "Pods", "value": q, "periodSeconds": 60}
+                            ],
+                        },
+                    },
+                )
+            ],
+        }
     return {
         f"{spec.app}-deployment.yaml": [
             workload_deployment(
@@ -950,7 +1043,7 @@ def render_pipeline(spec: PipelineSpec) -> dict[str, list[dict]]:
             )
         ],
         f"{spec.app}-adapter-values.yaml": [
-            adapter_values([adapter_rule(spec.record)])
+            adapter_values([adapter_rule(spec.record)], external_rules=[])
         ],
         f"{spec.app}-hpa.yaml": [
             hpa_manifest(

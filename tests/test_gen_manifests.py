@@ -142,3 +142,71 @@ def test_rendered_pipeline_closes_loop_in_simulator():
 def test_to_yaml_round_trips():
     docs = BUNDLE["tpu-metrics-exporter.yaml"]
     assert list(yaml.safe_load_all(manifests.to_yaml(docs))) == docs
+
+
+def test_multihost_pipeline_spec_renders_slice_shape():
+    """hosts_per_slice > 1: StatefulSet-of-slices with headless service,
+    statefulset-addressed rule/adapter, quantum annotation, and slice-multiple
+    bounds/policies — the whole v5p shape from one spec."""
+    spec = manifests.PipelineSpec(
+        app="llm-serve",
+        hosts_per_slice=4,
+        tpu_limit=4,
+        topology="2x2x4",
+        accelerator=manifests.ACCEL_V5P,
+        min_slices=1,
+        max_slices=3,
+    )
+    files = manifests.render_pipeline(spec)
+    assert set(files) == {
+        "llm-serve-statefulset.yaml",
+        "llm-serve-prometheusrule.yaml",
+        "llm-serve-adapter-values.yaml",
+        "llm-serve-hpa.yaml",
+    }
+    svc, sts = files["llm-serve-statefulset.yaml"]
+    assert svc["spec"]["clusterIP"] == "None"
+    env = {
+        e["name"]: e.get("value")
+        for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["HOSTS_PER_SLICE"] == "4"
+    assert env["HEADLESS_SERVICE"] == "llm-serve"
+
+    rule = files["llm-serve-prometheusrule.yaml"][0]["spec"]["groups"][0]["rules"][0]
+    assert rule["labels"] == {"namespace": "default", "statefulset": "llm-serve"}
+
+    adapter = files["llm-serve-adapter-values.yaml"][0]
+    overrides = adapter["rules"]["custom"][0]["resources"]["overrides"]
+    assert "statefulset" in overrides
+    assert adapter["rules"]["external"] == []
+
+    hpa = files["llm-serve-hpa.yaml"][0]
+    assert hpa["metadata"]["annotations"]["k8s-tpu-hpa/replica-quantum"] == "4"
+    assert hpa["spec"]["scaleTargetRef"]["kind"] == "StatefulSet"
+    assert hpa["spec"]["minReplicas"] == 4 and hpa["spec"]["maxReplicas"] == 12
+    for direction in ("scaleUp", "scaleDown"):
+        for policy in hpa["spec"]["behavior"][direction]["policies"]:
+            assert policy["value"] % 4 == 0
+
+
+def test_multihost_pipeline_cli(tmp_path):
+    from k8s_gpu_hpa_tpu.__main__ import main
+
+    rc = main(
+        [
+            "gen-pipeline",
+            "--app",
+            "llm-serve",
+            "--hosts-per-slice",
+            "2",
+            "--max-slices",
+            "2",
+            "-o",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    assert (tmp_path / "llm-serve-statefulset.yaml").exists()
+    hpa = yaml.safe_load((tmp_path / "llm-serve-hpa.yaml").read_text())
+    assert hpa["spec"]["maxReplicas"] == 4  # 2 slices x 2 hosts
