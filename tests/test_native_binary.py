@@ -52,15 +52,27 @@ def binary():
     return ensure_binary()
 
 
+def bound_port(proc) -> int:
+    """Parse the ephemeral port from the binary's startup line
+    ('tpu-metrics-exporter serving on ADDR:PORT ...') — fixed test ports
+    collide across parallel/lingering runs."""
+    line = proc.stderr.readline()
+    import re
+
+    m = re.search(r"serving on [\d.]+:(\d+)", line)
+    assert m, f"no serving line: {line!r}"
+    return int(m.group(1))
+
+
 def test_stdin_mode_serves_fed_sweep(binary):
-    port = 19417
     proc = subprocess.Popen(
-        [str(binary), "--listen", f"127.0.0.1:{port}", "--node", "bin-node",
+        [str(binary), "--listen", "127.0.0.1:0", "--node", "bin-node",
          "--source", "stdin", "--collect-ms", "100"],
         stdin=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
     )
+    port = bound_port(proc)
     try:
         proc.stdin.write("0 75 80 8e9 16e9 45\n1 25 30 2e9 16e9 10\n\n")
         proc.stdin.flush()
@@ -80,12 +92,13 @@ def test_stdin_mode_serves_fed_sweep(binary):
 
 
 def test_stub_mode_serves_synthetic_chips(binary):
-    port = 19418
     proc = subprocess.Popen(
-        [str(binary), "--listen", f"127.0.0.1:{port}", "--node", "stub-node",
+        [str(binary), "--listen", "127.0.0.1:0", "--node", "stub-node",
          "--source", "stub", "--collect-ms", "100"],
         stderr=subprocess.PIPE,
+        text=True,
     )
+    port = bound_port(proc)
     try:
         deadline = time.time() + 10
         fams = {}
