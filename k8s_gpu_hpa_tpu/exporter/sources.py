@@ -210,15 +210,28 @@ class MergedLibtpuSource:
         return MergedLibtpuSource(addresses=[f"localhost:{p}" for p in ports])
 
     def sample(self) -> list[ChipSample]:
+        # Ports are swept concurrently: serially, one dead port's connect
+        # timeout (3 s) would wedge every 1 s collect sweep behind it.
         merged: dict[int, ChipSample] = {}
         errors = []
-        for source in self._sources:
-            try:
-                chips = source.sample()
-            except Exception as e:
-                errors.append((source.address, e))
+        if len(self._sources) == 1:
+            results = [(self._sources[0], self._try_sample(self._sources[0]))]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            if not hasattr(self, "_pool"):
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(8, len(self._sources)),
+                    thread_name_prefix="libtpu-sweep",
+                )
+            results = list(
+                zip(self._sources, self._pool.map(self._try_sample, self._sources))
+            )
+        for source, outcome in results:
+            if isinstance(outcome, Exception):
+                errors.append((source.address, outcome))
                 continue
-            for chip in chips:
+            for chip in outcome:
                 seen = merged.get(chip.accel_index)
                 if seen is None or chip.duty_cycle > seen.duty_cycle:
                     merged[chip.accel_index] = chip
@@ -229,7 +242,16 @@ class MergedLibtpuSource:
             )
         return [merged[i] for i in sorted(merged)]
 
+    @staticmethod
+    def _try_sample(source: "LibtpuSource"):
+        try:
+            return source.sample()
+        except Exception as e:  # noqa: BLE001 — per-port outcome, never raises
+            return e
+
     def close(self) -> None:
+        if hasattr(self, "_pool"):
+            self._pool.shutdown(wait=False)
         for source in self._sources:
             source.close()
 

@@ -214,3 +214,25 @@ def test_merged_source_from_env_parses_gke_ports():
     assert source.addresses == ["localhost:8431", "localhost:8432"]
     default = MergedLibtpuSource.from_env({})
     assert default.addresses == ["localhost:8431"]
+
+
+def test_merged_source_sweeps_ports_concurrently():
+    """A dead port's timeout must not serialize behind live ports: the sweep
+    wall time stays near ONE timeout, not len(ports) x timeout."""
+    import time as _time
+
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    with StubLibtpuServer(num_chips=1, device_ids=[0]) as s1:
+        source = MergedLibtpuSource(
+            addresses=[s1.address, "localhost:1", "localhost:2", "localhost:3"],
+            timeout=1.0,
+        )
+        try:
+            t0 = _time.perf_counter()
+            chips = source.sample()
+            elapsed = _time.perf_counter() - t0
+            assert [c.accel_index for c in chips] == [0]
+            assert elapsed < 2.5, f"serialized timeouts: {elapsed:.1f}s"
+        finally:
+            source.close()
