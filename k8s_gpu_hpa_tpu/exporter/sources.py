@@ -187,6 +187,8 @@ class MergedLibtpuSource:
     addresses: list[str] = field(default_factory=lambda: ["localhost:8431"])
     timeout: float = 3.0
     _sources: list["LibtpuSource"] = field(default=None, repr=False)
+    #: lazy, recreated after close() (same lifecycle as LibtpuSource._channel)
+    _pool: object = field(default=None, repr=False)
 
     def __post_init__(self):
         if self._sources is None:
@@ -219,7 +221,7 @@ class MergedLibtpuSource:
         else:
             from concurrent.futures import ThreadPoolExecutor
 
-            if not hasattr(self, "_pool"):
+            if self._pool is None:
                 self._pool = ThreadPoolExecutor(
                     max_workers=min(8, len(self._sources)),
                     thread_name_prefix="libtpu-sweep",
@@ -250,8 +252,11 @@ class MergedLibtpuSource:
             return e
 
     def close(self) -> None:
-        if hasattr(self, "_pool"):
+        """Like LibtpuSource.close(): the source stays usable — the next
+        sample() lazily reconnects channels and recreates the pool."""
+        if self._pool is not None:
             self._pool.shutdown(wait=False)
+            self._pool = None
         for source in self._sources:
             source.close()
 

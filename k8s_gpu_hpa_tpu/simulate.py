@@ -180,7 +180,9 @@ def render_report(report: SimReport) -> str:
 
 
 def main(args) -> int:
-    hpa_doc = yaml.safe_load(open(args.hpa).read())
+    from pathlib import Path
+
+    hpa_doc = yaml.safe_load(Path(args.hpa).read_text())
     report = run_scenario(
         hpa_doc,
         scenario=args.scenario,

@@ -216,23 +216,62 @@ def test_merged_source_from_env_parses_gke_ports():
     assert default.addresses == ["localhost:8431"]
 
 
+def _black_hole_ports(n):
+    """Sockets that accept TCP but never speak gRPC: the client handshake
+    hangs until its deadline — the wedged-port shape (a refused localhost
+    port fails instantly and would not exercise the timeout path)."""
+    import socket
+
+    holes = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        holes.append(s)
+    return holes
+
+
 def test_merged_source_sweeps_ports_concurrently():
-    """A dead port's timeout must not serialize behind live ports: the sweep
-    wall time stays near ONE timeout, not len(ports) x timeout."""
+    """A wedged port's timeout must not serialize behind live ports: the
+    sweep wall time stays near ONE deadline, not len(ports) x deadline."""
     import time as _time
 
     from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
 
-    with StubLibtpuServer(num_chips=1, device_ids=[0]) as s1:
-        source = MergedLibtpuSource(
-            addresses=[s1.address, "localhost:1", "localhost:2", "localhost:3"],
-            timeout=1.0,
-        )
+    holes = _black_hole_ports(3)
+    try:
+        with StubLibtpuServer(num_chips=1, device_ids=[0]) as s1:
+            source = MergedLibtpuSource(
+                addresses=[s1.address]
+                + [f"localhost:{h.getsockname()[1]}" for h in holes],
+                timeout=1.0,
+            )
+            try:
+                t0 = _time.perf_counter()
+                chips = source.sample()
+                elapsed = _time.perf_counter() - t0
+                assert [c.accel_index for c in chips] == [0]
+                assert elapsed < 2.5, f"serialized timeouts: {elapsed:.1f}s"
+            finally:
+                source.close()
+    finally:
+        for h in holes:
+            h.close()
+
+
+def test_merged_source_usable_after_close():
+    """close() must not brick the source: LibtpuSource reconnects lazily
+    after close(), and the merged wrapper keeps that contract (the daemon's
+    error path relies on it)."""
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    with StubLibtpuServer(num_chips=1, device_ids=[0]) as s1, StubLibtpuServer(
+        num_chips=1, device_ids=[1]
+    ) as s2:
+        source = MergedLibtpuSource(addresses=[s1.address, s2.address])
         try:
-            t0 = _time.perf_counter()
-            chips = source.sample()
-            elapsed = _time.perf_counter() - t0
-            assert [c.accel_index for c in chips] == [0]
-            assert elapsed < 2.5, f"serialized timeouts: {elapsed:.1f}s"
+            assert len(source.sample()) == 2
+            source.close()
+            assert len(source.sample()) == 2  # pool + channels recreated
         finally:
             source.close()
