@@ -263,6 +263,19 @@ class SimCluster:
                 node.allocations.pop(idx, None)
         self.pods.pop(pod.name, None)
 
+    def kill_pod(self, name: str) -> None:
+        """Crash one pod (OOM, eviction, node blip).  The chips free
+        immediately; the ReplicaSet-controller behavior — notice the gap and
+        create a replacement, which then pays the start latency — runs at
+        once, exactly the elasticity Kubernetes gives for free and the
+        reference relies on implicitly (SURVEY.md §5)."""
+        pod = self.pods.get(name)
+        if pod is None:
+            raise KeyError(f"no pod {name}")
+        deployment = self.deployments[pod.deployment]
+        self._delete_pod(pod)
+        self.reconcile(deployment)
+
     # ---- metric endpoints --------------------------------------------------
 
     def exporter_fetch(self, node_name: str) -> str:

@@ -165,3 +165,33 @@ def test_load_flapping_at_target_does_not_flap_replicas(period):
     assert len(pipe.scale_history) - events_before <= 1, (
         f"replica flapping: {pipe.scale_history}"
     )
+
+
+def test_pod_crash_recovers_and_series_goes_stale():
+    """Crash one of three running pods: the replacement pays the start
+    latency, the dead pod's per-chip series goes stale at the next scrape
+    (never frozen), and the loop re-stabilizes at the same replica count —
+    the elastic-recovery path the reference gets implicitly from Kubernetes
+    (SURVEY.md §5), here actually exercised."""
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 90.0, chips=2)
+    clock.advance(120.0)
+    settled = pipe.replicas()
+    assert settled == 3  # 90% over target 40 -> ceil(1*2.25) -> 3 settles
+
+    victim = cluster.running_pods("tpu-test")[0].name
+    cluster.kill_pod(victim)
+    assert len(cluster.running_pods("tpu-test")) == settled - 1
+
+    clock.advance(2.0)  # one scrape after the crash
+    # the dead pod's chip series must be gone from the TSDB, not frozen
+    assert not pipe.db.instant_vector(
+        "tpu_tensorcore_utilization", {"pod": victim}
+    ), "crashed pod's series must be marked stale"
+
+    clock.advance(15.0)  # replacement pays pod_start_latency (12s)
+    assert len(cluster.running_pods("tpu-test")) == settled
+    names = {p.name for p in cluster.running_pods("tpu-test")}
+    assert victim not in names
+
+    clock.advance(120.0)  # loop re-stabilizes, no runaway scaling
+    assert pipe.replicas() == settled
