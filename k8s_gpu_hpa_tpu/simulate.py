@@ -16,6 +16,8 @@ Scenarios (offered load in percent-of-one-chip units; replicas share it):
                  scale-down stabilization window suppressing replica flap.
 - ``outage``   — steady mid load, exporters die at t=120 for 2 minutes:
                  shows the hold-don't-act failure semantics.
+- ``crash``    — steady high load, one pod crashes at t=120: shows the
+                 replacement paying start latency and the loop re-stabilizing.
 """
 
 from __future__ import annotations
@@ -39,6 +41,7 @@ SCENARIOS = {
     "ramp": lambda t: 20.0 + min(780.0, max(0.0, t - 60.0) * 780.0 / 600.0),
     "flap": lambda t: 80.0 + 8.0 * math.sin(2 * math.pi * t / 60.0),
     "outage": lambda t: 120.0,
+    "crash": lambda t: 90.0,
 }
 
 
@@ -113,6 +116,7 @@ def run_scenario(
     pipe.start()
 
     outage_window = (120.0, 240.0) if scenario == "outage" else None
+    crash_at: float | None = 120.0 if scenario == "crash" else None
     originals: list[tuple] = []
 
     report = SimReport(scenario=scenario)
@@ -131,6 +135,11 @@ def run_scenario(
             for tgt, fetch in originals:
                 tgt.fetch = fetch
             outage_window = None
+        if crash_at is not None and elapsed >= crash_at:
+            running = cluster.running_pods(dep.name)
+            if running:
+                cluster.kill_pod(running[0].name)
+            crash_at = None
 
         clock.advance(sample_every)
         elapsed += sample_every

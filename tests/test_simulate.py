@@ -63,3 +63,13 @@ def test_cli_prints_timeline(capsys):
     assert "scenario: spike" in out
     assert "scale event" in out
     assert "scale-up latency" in out
+
+
+def test_crash_scenario_replaces_pod_and_restabilizes():
+    report = run_scenario(load_hpa(), scenario="crash", duration=300.0)
+    # running dips by one right after the crash, then recovers
+    running = {t: r for t, _, _, _, r in report.timeline}
+    settled = running[115.0]
+    assert running[125.0] == settled - 1
+    assert running[145.0] == settled  # replacement landed (12s start latency)
+    assert report.timeline[-1][3] == settled  # replica count unchanged at end
