@@ -1,0 +1,96 @@
+"""Long-context LLM training load generator: the sequence-parallel
+transformer (models/transformer.py) under the standard duty-cycle knob.
+
+The most realistic load profile in the ladder: per step, ``n_layers`` KV
+rings over ICI, dense matmuls on every chip, and one gradient psum — the
+signature of ring-attention training (context ``n_devices``× longer than one
+chip holds).  Same knob/self-reporting contract as every other generator;
+selectable in the multi-host container via ``WORKLOAD=llm``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from k8s_gpu_hpa_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    make_train_step,
+)
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+
+@dataclass
+class LlmStats:
+    steps: int
+    context_length: int
+    last_loss: float
+    tokens_per_sec: float
+    seconds: float
+
+
+class LlmLoadGen:
+    """Busy-loop of causal-LM training steps over a ring-sharded context."""
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        seq_per_device: int = 2048,
+        batch: int = 1,
+        d_model: int = 512,
+        n_heads: int = 8,
+        n_layers: int = 4,
+        dtype=jnp.bfloat16,
+        lr: float = 1e-3,
+    ):
+        self.mesh = mesh or make_mesh()
+        n = self.mesh.shape[DATA_AXIS]
+        self.cfg = TransformerConfig(
+            d_model=d_model,
+            n_heads=n_heads,
+            n_layers=n_layers,
+            d_ff=4 * d_model,
+            max_seq=seq_per_device * n,
+            dtype=dtype,
+        )
+        self.batch = batch
+        self._params = init_params(jax.random.PRNGKey(0), self.cfg)
+        self._step = make_train_step(self.mesh, self.cfg, lr=lr)
+        self._tokens = jax.random.randint(
+            jax.random.PRNGKey(1),
+            (batch, self.cfg.max_seq),
+            0,
+            self.cfg.vocab,
+            jnp.int32,
+        )
+        self._steps = 0
+        self._busy = 0.0
+        self._last_loss = float("nan")
+
+    def warmup(self) -> None:
+        self._params, loss = self._step(self._params, self._tokens)
+        self._last_loss = float(loss)
+
+    def step(self) -> float:
+        t0 = time.perf_counter()
+        self._params, loss = self._step(self._params, self._tokens)
+        self._last_loss = float(loss)  # fetch forces completion
+        dt = time.perf_counter() - t0
+        self._busy += dt
+        self._steps += 1
+        return dt
+
+    def stats(self) -> LlmStats:
+        tokens = self.batch * self.cfg.max_seq * self._steps
+        return LlmStats(
+            steps=self._steps,
+            context_length=self.cfg.max_seq,
+            last_loss=self._last_loss,
+            tokens_per_sec=tokens / self._busy if self._busy else 0.0,
+            seconds=self._busy,
+        )

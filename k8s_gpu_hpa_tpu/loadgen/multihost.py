@@ -143,7 +143,25 @@ def main() -> None:
     topology = initialize()
     mesh = make_mesh()
     workload = os.environ.get("WORKLOAD", "allreduce")
-    if workload == "ringattn":
+    if workload == "llm":
+        # long-context LLM training: ring attention inside a real model
+        from k8s_gpu_hpa_tpu.loadgen.llm import LlmLoadGen
+
+        gen = LlmLoadGen(
+            mesh=mesh,
+            seq_per_device=int(os.environ.get("SEQ_PER_DEVICE", "2048")),
+            batch=int(os.environ.get("BATCH_SIZE", "1")),
+            d_model=int(os.environ.get("D_MODEL", "512")),
+            n_layers=int(os.environ.get("N_LAYERS", "4")),
+        )
+
+        def report(s):
+            return (
+                f"steps={s.steps} ctx={s.context_length} loss={s.last_loss:.3f} "
+                f"tok/s={s.tokens_per_sec:.0f} busy={s.seconds:.1f}s"
+            )
+
+    elif workload == "ringattn":
         # long-context rung: sequence-parallel attention over the slice's ring
         from k8s_gpu_hpa_tpu.loadgen.ringattn import RingAttentionLoadGen
 

@@ -1,0 +1,213 @@
+"""Sequence-parallel decoder transformer: ring attention inside a real model.
+
+The long-context model family.  The sequence dimension is sharded over the
+mesh's data axis — the context is ``n`` times longer than one chip could
+hold — and the ONLY communicating op is attention (the KV ring,
+ops/ring_attention.py); everything else (embeddings, RMSNorm, the MLP, the
+LM head, the loss) is elementwise or contracting over non-sequence dims and
+runs entirely on the local shard.  Weights are replicated; the training step
+psums gradients over the ring axis (data-parallel in weights, sequence-
+parallel in activations — Liu et al.'s ring-attention training shape).
+
+Pure jax + shard_map (no flax), one dtype knob, static shapes throughout:
+the whole forward/backward compiles to one XLA program per device with
+exactly ``n_layers`` ppermute rings plus one gradient psum.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.ops.ring_attention import ring_attention_local
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256  # byte-level
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 4096  # TOTAL context (sharded over the ring)
+    dtype: object = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Replicated parameter pytree (plain dict of arrays)."""
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
+
+    def dense(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params: dict = {
+        "embed": dense(next(keys), (cfg.vocab, cfg.d_model), 0.02),
+        "pos": dense(next(keys), (cfg.max_seq, cfg.d_model), 0.02),
+        "out_norm": jnp.ones((cfg.d_model,), cfg.dtype),
+        "blocks": [],
+    }
+    scale = 1.0 / (cfg.d_model**0.5)
+    for _ in range(cfg.n_layers):
+        params["blocks"].append(
+            {
+                "attn_norm": jnp.ones((cfg.d_model,), cfg.dtype),
+                "wqkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model), scale),
+                "wo": dense(next(keys), (cfg.d_model, cfg.d_model), scale),
+                "mlp_norm": jnp.ones((cfg.d_model,), cfg.dtype),
+                "w1": dense(next(keys), (cfg.d_model, cfg.d_ff), scale),
+                "w2": dense(next(keys), (cfg.d_ff, cfg.d_model), 1.0 / (cfg.d_ff**0.5)),
+            }
+        )
+    return params
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
+
+
+def forward_local(
+    params: dict,
+    tokens: jax.Array,  # [batch, local_seq] int32, this device's shard
+    cfg: TransformerConfig,
+    axis: str,
+    n: int,
+) -> jax.Array:
+    """Per-device forward (call inside shard_map over ``axis``): logits for
+    the local sequence shard.  Position embeddings index by GLOBAL position
+    (shard offset from axis_index)."""
+    b, lq = tokens.shape
+    my = lax.axis_index(axis)
+    pos = my * lq + jnp.arange(lq)
+    x = params["embed"][tokens] + params["pos"][pos][None, :, :].astype(cfg.dtype)
+
+    # layer remat (jax.checkpoint): trade FLOPs for HBM — the backward pass
+    # recomputes each block's activations instead of keeping n_layers x
+    # [b, lq, d_ff] residuals live, which is what bounds context length
+    @jax.checkpoint
+    def block(x, blk):
+        h = _rmsnorm(x, blk["attn_norm"])
+        qkv = jnp.einsum(
+            "bsd,de->bse", h, blk["wqkv"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, lq, cfg.n_heads, cfg.head_dim)
+        attn = ring_attention_local(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape), axis, n, causal=True
+        ).reshape(b, lq, cfg.d_model)
+        x = x + jnp.einsum(
+            "bsd,de->bse", attn, blk["wo"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        h = _rmsnorm(x, blk["mlp_norm"])
+        up = jnp.einsum(
+            "bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32
+        )
+        x = x + jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(up).astype(cfg.dtype),
+            blk["w2"],
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        return x
+
+    for blk in params["blocks"]:
+        x = block(x, blk)
+    x = _rmsnorm(x, params["out_norm"])
+    return jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )  # tied LM head, f32 logits
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
+    """(params, tokens[batch, total_seq]) -> (params, loss): one SGD step.
+
+    Next-token loss over the sequence ring: each device's shard predicts its
+    own next tokens (the last position of shard i predicts the first token of
+    shard i+1, fetched by a single ppermute).  Grads psum over the ring axis,
+    so weights stay replicated bit-identically.
+    """
+    n = mesh.shape[DATA_AXIS]
+    seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def step(params, tokens):
+        def local_loss(p):
+            logits = forward_local(p, tokens, cfg, DATA_AXIS, n)
+            # target for the last local position = first token of the next
+            # shard (one ring hop); the global last position wraps to shard 0
+            # and is masked out of the loss
+            perm = [(j, (j - 1) % n) for j in range(n)]
+            next_first = lax.ppermute(tokens[:, :1], DATA_AXIS, perm)
+            targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            my = lax.axis_index(DATA_AXIS)
+            lq = tokens.shape[1]
+            is_global_last = (my == n - 1) & (jnp.arange(lq) == lq - 1)
+            # broadcast to [batch, lq] so count includes the batch factor
+            weights = jnp.where(is_global_last[None, :], 0.0, jnp.ones_like(nll))
+            # mean over the GLOBAL token count (identical on every device)
+            total = lax.psum(jnp.sum(nll * weights), DATA_AXIS)
+            count = lax.psum(jnp.sum(weights), DATA_AXIS)
+            return total / count
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        grads = jax.tree.map(lambda g: lax.psum(g, DATA_AXIS) / n, grads)
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(
+                p.dtype
+            ),
+            params,
+            grads,
+        )
+        return new_params, loss
+
+    jitted = jax.jit(step)
+
+    def train_step(params, tokens):
+        tokens = jax.device_put(tokens, seq_sharding)
+        params = jax.device_put(params, repl)
+        return jitted(params, tokens)
+
+    return train_step
+
+
+def make_forward(mesh: Mesh, cfg: TransformerConfig):
+    """(params, tokens[batch, total_seq]) -> logits, sequence-sharded."""
+    n = mesh.shape[DATA_AXIS]
+    seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS)),
+        out_specs=P(None, DATA_AXIS),
+        check_vma=False,
+    )
+    def fwd(params, tokens):
+        return forward_local(params, tokens, cfg, DATA_AXIS, n)
+
+    jitted = jax.jit(fwd)
+
+    def forward(params, tokens):
+        return jitted(params, jax.device_put(tokens, seq_sharding))
+
+    return forward

@@ -91,10 +91,54 @@ def _block_attn(q, k, v, q_off, k_off, causal, kv_chunk):
     m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, lq), jnp.float32)
     o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    # remat per chunk: without it, autodiff saves every chunk's [lq, chunk]
+    # score slab as a scan residual — gigabytes per layer at long context —
+    # and the whole memory win of chunking evaporates in the backward pass
+    # (the flash-attention backward is recompute-by-design)
     (m, l, o), _ = lax.scan(
-        step, (m0, l0, o0), (jnp.arange(n_chunks), (ks, vs))
+        jax.checkpoint(step), (m0, l0, o0), (jnp.arange(n_chunks), (ks, vs))
     )
     return m, l, o
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    n: int,
+    causal: bool = False,
+    kv_chunk: int | None = 512,
+) -> jax.Array:
+    """The per-device ring body, for use INSIDE an existing ``shard_map`` over
+    ``axis`` (e.g. a sequence-parallel transformer block,
+    models/transformer.py): local [b, lq, h, d] shards in, local out —
+    KV blocks rotate ``n`` hops with exact online-softmax merges."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    my = lax.axis_index(axis)
+    qf = q.astype(jnp.float32)
+
+    def step(s, carry):
+        m, l, o, kb, vb = carry
+        # the block resident at step s started on device (my - s) mod n
+        k_off = ((my - s) % n) * lk
+        bm, bl, bo = _block_attn(
+            qf, kb.astype(jnp.float32), vb, my * lq, k_off, causal, kv_chunk
+        )
+        m, l, o = _merge(m, l, o, bm, bl, bo)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return m, l, o, kb, vb
+
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
+    # fully-masked rows (causal, all-future block) have l == 0: emit 0
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
 def ring_attention(
@@ -131,31 +175,9 @@ def ring_attention(
         check_vma=False,
     )
     def ring(q, k, v):
-        b, lq, h, d = q.shape
-        lk = k.shape[1]
-        my = lax.axis_index(axis)
-        qf = q.astype(jnp.float32)
-
-        def step(s, carry):
-            m, l, o, kb, vb = carry
-            # the block resident at step s started on device (my - s) mod n
-            k_off = ((my - s) % n) * lk
-            bm, bl, bo = _block_attn(
-                qf, kb.astype(jnp.float32), vb, my * lq, k_off, causal, kv_chunk
-            )
-            m, l, o = _merge(m, l, o, bm, bl, bo)
-            perm = [(j, (j + 1) % n) for j in range(n)]
-            kb = lax.ppermute(kb, axis, perm)
-            vb = lax.ppermute(vb, axis, perm)
-            return m, l, o, kb, vb
-
-        m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h, lq), jnp.float32)
-        o0 = jnp.zeros((b, h, lq, d), jnp.float32)
-        m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
-        # fully-masked rows (causal, all-future block) have l == 0: emit 0
-        out = o / jnp.maximum(l, 1e-30)[..., None]
-        return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+        return ring_attention_local(
+            q, k, v, axis, n, causal=causal, kv_chunk=kv_chunk
+        )
 
     q = jax.device_put(q, seq_sharding)
     k = jax.device_put(k, seq_sharding)

@@ -1,0 +1,101 @@
+"""Sequence-parallel transformer: parity with a single-device forward, loss
+masking at the ring seam, and training convergence on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_hpa_tpu.models.transformer import (
+    TransformerConfig,
+    forward_local,
+    init_params,
+    make_forward,
+    make_train_step,
+)
+from k8s_gpu_hpa_tpu.parallel.mesh import make_mesh
+
+CFG = TransformerConfig(d_model=64, n_heads=2, n_layers=2, d_ff=128, max_seq=64, dtype=jnp.float32)
+
+
+def tokens_for(cfg, batch=2, seed=3):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, cfg.max_seq), 0, cfg.vocab, jnp.int32
+    )
+
+
+def single_device_logits(params, tokens, cfg):
+    """Reference: the same forward on an n=1 'ring' (single-device mesh)."""
+    mesh = make_mesh(n_devices=1)
+    return make_forward(mesh, cfg)(params, tokens)
+
+
+def test_sharded_forward_matches_single_device():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = tokens_for(CFG)
+    want = single_device_logits(params, tokens, CFG)
+    got = make_forward(make_mesh(n_devices=8), CFG)(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_causality_across_shard_boundaries():
+    """Changing a late token must not move any earlier position's logits —
+    including positions on EARLIER shards of the ring."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = tokens_for(CFG)
+    fwd = make_forward(make_mesh(n_devices=8), CFG)
+    base = np.asarray(fwd(params, tokens))
+    poked = tokens.at[:, CFG.max_seq - 3].set((tokens[:, CFG.max_seq - 3] + 1) % CFG.vocab)
+    out = np.asarray(fwd(params, poked))
+    cut = CFG.max_seq - 3
+    np.testing.assert_allclose(out[:, :cut], base[:, :cut], rtol=1e-5, atol=1e-5)
+    assert not np.allclose(out[:, cut:], base[:, cut:])
+
+
+def test_train_step_reduces_loss_and_keeps_replicas_identical():
+    mesh = make_mesh(n_devices=8)
+    params = init_params(jax.random.PRNGKey(1), CFG)
+    tokens = tokens_for(CFG, seed=7)
+    step = make_train_step(mesh, CFG, lr=0.5)
+    params, first = step(params, tokens)
+    losses = [float(first)]
+    for _ in range(15):
+        params, loss = step(params, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+    # weights stayed replicated: one logical value per param
+    leaf = jax.tree.leaves(params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_loss_is_finite_and_near_uniform_at_init():
+    mesh = make_mesh(n_devices=4)
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    step = make_train_step(mesh, CFG, lr=0.0)
+    _, loss = step(params, tokens_for(CFG))
+    assert np.isfinite(float(loss))
+    # ~log(vocab) at random init
+    assert abs(float(loss) - np.log(CFG.vocab)) < 1.0
+
+
+def test_llm_loadgen_trains_on_virtual_mesh():
+    from k8s_gpu_hpa_tpu.loadgen.llm import LlmLoadGen
+
+    gen = LlmLoadGen(
+        mesh=make_mesh(n_devices=8),
+        seq_per_device=16,
+        batch=1,
+        d_model=64,
+        n_heads=2,
+        n_layers=2,
+    )
+    gen.warmup()
+    gen.step()
+    s = gen.stats()
+    assert s.steps == 1  # warmup primes the compile; only step() counts
+    assert s.context_length == 128
+    assert np.isfinite(s.last_loss)
+    assert s.tokens_per_sec > 0
