@@ -94,3 +94,31 @@ class LlmLoadGen:
             tokens_per_sec=tokens / self._busy if self._busy else 0.0,
             seconds=self._busy,
         )
+
+    # ---- checkpoint / resume (orbax; same contract as loadgen/train.py) ----
+
+    def checkpoint_state(self) -> dict:
+        return {"params": self._params, "step": self._steps, "busy": self._busy}
+
+    def save_checkpoint(self, manager) -> None:
+        import orbax.checkpoint as ocp
+
+        manager.save(self._steps, args=ocp.args.StandardSave(self.checkpoint_state()))
+
+    def restore_checkpoint(self, manager) -> bool:
+        """Resume from the newest checkpoint; False when none exists.  Params
+        re-placed replicated on this mesh (the train step's weight layout)."""
+        import orbax.checkpoint as ocp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        latest = manager.latest_step()
+        if latest is None:
+            return False
+        restored = manager.restore(
+            latest, args=ocp.args.StandardRestore(self.checkpoint_state())
+        )
+        replicated = NamedSharding(self.mesh, P())
+        self._params = jax.device_put(restored["params"], replicated)
+        self._steps = int(restored["step"])
+        self._busy = float(restored["busy"])
+        return True

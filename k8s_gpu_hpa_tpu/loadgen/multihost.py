@@ -191,6 +191,18 @@ def main() -> None:
                 f"busy={s.seconds:.1f}s"
             )
 
+    # checkpoint/resume (llm rung; same contract as the training rung) —
+    # scale-down kills whole slices, checkpointing makes that loss-free
+    manager = None
+    ckpt_dir = os.environ.get("CHECKPOINT_DIR", "")
+    ckpt_every = int(os.environ.get("CHECKPOINT_EVERY", "100"))
+    if ckpt_dir and hasattr(gen, "save_checkpoint"):
+        from k8s_gpu_hpa_tpu.loadgen.train import make_checkpoint_manager
+
+        manager = make_checkpoint_manager(ckpt_dir)
+        if gen.restore_checkpoint(manager):
+            print(f"resumed from step {gen.stats().steps} in {ckpt_dir}", flush=True)
+
     gen.warmup()
     knob = IntensityKnob()
     report_every = float(os.environ.get("REPORT_S", "10"))
@@ -201,12 +213,33 @@ def main() -> None:
         f"(knob: {knob.file})",
         flush=True,
     )
+
+    import signal
+
+    stopping = False
+
+    def _terminate(signum, frame):
+        nonlocal stopping
+        stopping = True
+
+    signal.signal(signal.SIGTERM, _terminate)
+
     last_report = time.perf_counter()
+    last_ckpt_step = gen.stats().steps
     while True:
+        if stopping:
+            if manager is not None and gen.stats().steps > last_ckpt_step:
+                gen.save_checkpoint(manager)
+                manager.wait_until_finished()
+                print(f"final checkpoint at step {gen.stats().steps}", flush=True)
+            return
         if knob.poll() <= 0.0:
             knob.throttle(0.0)
         else:
             knob.throttle(gen.step())
+        if manager is not None and gen.stats().steps - last_ckpt_step >= ckpt_every:
+            gen.save_checkpoint(manager)
+            last_ckpt_step = gen.stats().steps
         if time.perf_counter() - last_report >= report_every:
             print(report(gen.stats()), flush=True)
             last_report = time.perf_counter()

@@ -99,3 +99,36 @@ def test_llm_loadgen_trains_on_virtual_mesh():
     assert s.context_length == 128
     assert np.isfinite(s.last_loss)
     assert s.tokens_per_sec > 0
+
+
+def test_llm_checkpoint_roundtrip(tmp_path):
+    """Save at step N, build a fresh generator (fresh RNG-derived params),
+    restore: params identical and the step counter continues from N."""
+    from k8s_gpu_hpa_tpu.loadgen.llm import LlmLoadGen
+    from k8s_gpu_hpa_tpu.loadgen.train import make_checkpoint_manager
+
+    mesh = make_mesh(n_devices=4)
+    kwargs = dict(
+        mesh=mesh, seq_per_device=16, batch=1, d_model=64, n_heads=2, n_layers=2
+    )
+    gen = LlmLoadGen(**kwargs)
+    gen.warmup()
+    gen.step()
+    with make_checkpoint_manager(str(tmp_path)) as manager:
+        gen.save_checkpoint(manager)
+        manager.wait_until_finished()
+        trained = gen._params["embed"]
+
+        fresh = LlmLoadGen(**kwargs)
+        assert not np.allclose(
+            np.asarray(fresh._params["embed"], np.float32),
+            np.asarray(trained, np.float32),
+        )
+        assert fresh.restore_checkpoint(manager)
+        np.testing.assert_array_equal(
+            np.asarray(fresh._params["embed"], np.float32),
+            np.asarray(trained, np.float32),
+        )
+        assert fresh.stats().steps == 1
+        fresh.step()  # training continues on the restored state
+        assert np.isfinite(fresh.stats().last_loss)
