@@ -76,8 +76,12 @@ def test_stdin_mode_serves_fed_sweep(binary):
     try:
         proc.stdin.write("0 75 80 8e9 16e9 45\n1 25 30 2e9 16e9 10\n\n")
         proc.stdin.flush()
-        text = wait_http(port)
-        fams = {f.name: f for f in parse_text(text)}
+        # the first 200 can precede the stdin sweep being consumed; poll
+        # until the chip gauges appear (same pattern as the stub-mode test)
+        deadline = time.time() + 10
+        fams = {}
+        while time.time() < deadline and "tpu_tensorcore_utilization" not in fams:
+            fams = {f.name: f for f in parse_text(wait_http(port))}
         up = fams["tpu_metrics_exporter_up"].samples[0]
         assert up.value == 1.0 and up.label("node") == "bin-node"
         utils = {
