@@ -47,6 +47,17 @@ def wait_http(port: int, deadline: float = 10.0) -> str:
     raise TimeoutError(f"no /metrics on :{port}")
 
 
+def wait_for_family(port: int, name: str, deadline: float = 10.0) -> dict:
+    """Poll /metrics until the named family appears (the server answers 200
+    before its first sweep is consumed); returns the parsed family dict."""
+    end = time.time() + deadline
+    while True:
+        fams = {f.name: f for f in parse_text(wait_http(port))}
+        if name in fams or time.time() >= end:
+            return fams
+        time.sleep(0.05)
+
+
 @pytest.fixture(scope="module")
 def binary():
     return ensure_binary()
@@ -76,12 +87,7 @@ def test_stdin_mode_serves_fed_sweep(binary):
     try:
         proc.stdin.write("0 75 80 8e9 16e9 45\n1 25 30 2e9 16e9 10\n\n")
         proc.stdin.flush()
-        # the first 200 can precede the stdin sweep being consumed; poll
-        # until the chip gauges appear (same pattern as the stub-mode test)
-        deadline = time.time() + 10
-        fams = {}
-        while time.time() < deadline and "tpu_tensorcore_utilization" not in fams:
-            fams = {f.name: f for f in parse_text(wait_http(port))}
+        fams = wait_for_family(port, "tpu_tensorcore_utilization")
         up = fams["tpu_metrics_exporter_up"].samples[0]
         assert up.value == 1.0 and up.label("node") == "bin-node"
         utils = {
@@ -104,10 +110,7 @@ def test_stub_mode_serves_synthetic_chips(binary):
     )
     port = bound_port(proc)
     try:
-        deadline = time.time() + 10
-        fams = {}
-        while time.time() < deadline and "tpu_tensorcore_utilization" not in fams:
-            fams = {f.name: f for f in parse_text(wait_http(port))}
+        fams = wait_for_family(port, "tpu_tensorcore_utilization")
         assert len(fams["tpu_tensorcore_utilization"].samples) == 4
         for s in fams["tpu_hbm_memory_total_bytes"].samples:
             assert s.value == 16e9
