@@ -1,5 +1,13 @@
-"""Entrypoint: ``python -m k8s_gpu_hpa_tpu.loadgen`` (tpu-test container cmd)."""
+"""Entrypoint: ``python -m k8s_gpu_hpa_tpu.loadgen`` (tpu-test container cmd).
 
-from k8s_gpu_hpa_tpu.loadgen.matmul import main
+``WORKLOAD`` selects the load profile: ``matmul`` (default — MXU-bound
+busy-loop) or ``decode`` (KV-cache serving — HBM-bandwidth-bound)."""
+
+import os
+
+if os.environ.get("WORKLOAD", "matmul") == "decode":
+    from k8s_gpu_hpa_tpu.loadgen.decode import main
+else:
+    from k8s_gpu_hpa_tpu.loadgen.matmul import main
 
 main()

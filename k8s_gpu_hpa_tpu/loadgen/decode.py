@@ -1,0 +1,157 @@
+"""Serving load generator: autoregressive KV-cache decode.
+
+The inference-side load profile: one token per step against the whole cache
+— small matmuls, large sequential HBM reads — so the chip signature is HBM
+*bandwidth*, not MXU occupancy.  That is exactly the signal the
+``tpu_test_hbm_bw_avg`` / training-rung multi-metric HPAs scale on; this
+generator produces it honestly where the matmul busy-loop cannot.
+
+Greedy decode keeps everything on-device: the sampled token feeds the next
+step inside one ``lax.fori_loop`` dispatch (``tokens_per_burst`` steps per
+host round-trip, same dispatch-amortization as every other generator).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from k8s_gpu_hpa_tpu.models.transformer import (
+    TransformerConfig,
+    decode_step,
+    init_kv_cache,
+    init_params,
+)
+
+
+@dataclass
+class DecodeStats:
+    steps: int  # bursts
+    tokens_generated: int
+    tokens_per_sec: float
+    cache_bytes: int
+    seconds: float
+
+
+class DecodeLoadGen:
+    """Busy-loop of greedy KV-cache decode bursts on the local device."""
+
+    def __init__(
+        self,
+        batch: int = 8,
+        max_seq: int = 2048,
+        d_model: int = 512,
+        n_heads: int = 8,
+        n_layers: int = 4,
+        tokens_per_burst: int | None = None,
+        dtype=jnp.bfloat16,
+    ):
+        self.cfg = TransformerConfig(
+            d_model=d_model,
+            n_heads=n_heads,
+            n_layers=n_layers,
+            d_ff=4 * d_model,
+            max_seq=max_seq,
+            dtype=dtype,
+        )
+        self.batch = batch
+        if tokens_per_burst is None:
+            tokens_per_burst = 128 if jax.default_backend() == "tpu" else 4
+        self.tokens_per_burst = tokens_per_burst
+        self._params = init_params(jax.random.PRNGKey(0), self.cfg)
+        self._cache = init_kv_cache(self.cfg, batch)
+        self._tokens = jnp.zeros((batch,), jnp.int32)
+        self._pos = jnp.int32(0)
+        cfg = self.cfg
+
+        def burst(params, tokens, cache, pos):
+            def body(_, carry):
+                tokens, cache, pos = carry
+                logits, cache = decode_step(params, cfg, tokens, cache, pos)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # wrap before max_seq so the burst loop never writes past the
+                # static cache (serving would evict/restart the sequence)
+                return nxt, cache, (pos + 1) % (cfg.max_seq - 1)
+
+            tokens, cache, pos = lax.fori_loop(
+                0, self.tokens_per_burst, body, (tokens, cache, pos)
+            )
+            return tokens, cache, pos
+
+        self._burst = jax.jit(burst)
+        self._steps = 0
+        self._busy = 0.0
+
+    def warmup(self) -> None:
+        self._run_burst()
+
+    def _run_burst(self) -> None:
+        self._tokens, self._cache, self._pos = self._burst(
+            self._params, self._tokens, self._cache, self._pos
+        )
+        jax.block_until_ready(self._tokens)
+        float(self._tokens[0])  # force completion on remote-tunnel backends
+
+    def step(self) -> float:
+        t0 = time.perf_counter()
+        self._run_burst()
+        dt = time.perf_counter() - t0
+        self._busy += dt
+        self._steps += 1
+        return dt
+
+    def stats(self) -> DecodeStats:
+        tokens = self.batch * self.tokens_per_burst * self._steps
+        cache_bytes = sum(
+            arr.size * arr.dtype.itemsize for arr in self._cache.values()
+        )
+        return DecodeStats(
+            steps=self._steps,
+            tokens_generated=tokens,
+            tokens_per_sec=tokens / self._busy if self._busy else 0.0,
+            cache_bytes=cache_bytes,
+            seconds=self._busy,
+        )
+
+
+def main() -> None:
+    """``WORKLOAD=decode python -m k8s_gpu_hpa_tpu.loadgen`` — the serving
+    container shape.  Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_LAYERS, plus the
+    standard intensity knob (TPU_TEST_INTENSITY / the watched file)."""
+    import os
+
+    from k8s_gpu_hpa_tpu.loadgen.knob import IntensityKnob
+
+    gen = DecodeLoadGen(
+        batch=int(os.environ.get("DECODE_BATCH", "8")),
+        max_seq=int(os.environ.get("MAX_SEQ", "2048")),
+        d_model=int(os.environ.get("D_MODEL", "512")),
+        n_layers=int(os.environ.get("N_LAYERS", "4")),
+    )
+    gen.warmup()
+    knob = IntensityKnob()
+    report_every = float(os.environ.get("REPORT_S", "10"))
+    print(
+        f"tpu-test decode loadgen: batch={gen.batch} ctx={gen.cfg.max_seq} "
+        f"cache={gen.stats().cache_bytes / 1e6:.0f}MB on "
+        f"{jax.devices()[0].device_kind} (knob: {knob.file})",
+        flush=True,
+    )
+    last_report = time.perf_counter()
+    while True:
+        if knob.poll() <= 0.0:
+            knob.throttle(0.0)
+        else:
+            knob.throttle(gen.step())
+        if time.perf_counter() - last_report >= report_every:
+            s = gen.stats()
+            print(
+                f"bursts={s.steps} tok/s={s.tokens_per_sec:.0f} "
+                f"busy={s.seconds:.1f}s",
+                flush=True,
+            )
+            last_report = time.perf_counter()

@@ -190,6 +190,80 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
     return train_step
 
 
+def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
+    """Static-shape KV cache: [layers][2][batch, max_seq, heads, head_dim].
+    Static shapes keep the decode step a single compiled program; masking by
+    position stands in for a growing cache (XLA-friendly, no dynamic shapes)."""
+    shape = (batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros((cfg.n_layers, *shape), cfg.dtype),
+        "v": jnp.zeros((cfg.n_layers, *shape), cfg.dtype),
+    }
+
+
+def decode_step(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [batch] int32 — the tokens at position ``pos``
+    cache: dict,
+    pos: jax.Array,  # scalar int32
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step (single device): logits for the next position
+    plus the updated cache.  The serving hot loop — small matmuls against the
+    whole cache make it HBM-bandwidth-bound, the opposite profile of the
+    prefill/training path (loadgen/decode.py builds the load rung on it)."""
+    b = tokens.shape[0]
+    x = params["embed"][tokens][:, None, :] + params["pos"][pos][None, None, :].astype(
+        cfg.dtype
+    )
+    new_k, new_v = [], []
+    for i, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["attn_norm"])
+        qkv = jnp.einsum(
+            "bsd,de->bse", h, blk["wqkv"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, 1, cfg.n_heads, cfg.head_dim)
+        k_cache = lax.dynamic_update_slice(
+            cache["k"][i], k.reshape(shape), (0, pos, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            cache["v"][i], v.reshape(shape), (0, pos, 0, 0)
+        )
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        qh = q.reshape(b, cfg.n_heads, cfg.head_dim)
+        s = jnp.einsum(
+            "bhd,bthd->bht", qh, k_cache, preferred_element_type=jnp.float32
+        ) / (cfg.head_dim**0.5)
+        s = jnp.where(jnp.arange(cfg.max_seq)[None, None, :] <= pos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum(
+            "bht,bthd->bhd", p, v_cache.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = x + jnp.einsum(
+            "bsd,de->bse",
+            attn.reshape(b, 1, cfg.d_model),
+            blk["wo"],
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        h = _rmsnorm(x, blk["mlp_norm"])
+        up = jnp.einsum(
+            "bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32
+        )
+        x = x + jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(up).astype(cfg.dtype),
+            blk["w2"],
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+    x = _rmsnorm(x, params["out_norm"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )[:, 0]
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
 def make_forward(mesh: Mesh, cfg: TransformerConfig):
     """(params, tokens[batch, total_seq]) -> logits, sequence-sharded."""
     n = mesh.shape[DATA_AXIS]
