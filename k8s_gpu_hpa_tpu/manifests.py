@@ -388,6 +388,17 @@ def shipped_rule_groups() -> list[tuple[str, list[RecordingRule]]]:
             ],
         ),
         (
+            "tpu-serve",
+            [
+                tpu_test_avg_rule(
+                    app="tpu-serve",
+                    deployment="tpu-serve",
+                    metric=TPU_HBM_BW_UTIL,
+                    record="tpu_serve_hbm_bw_avg",
+                )
+            ],
+        ),
+        (
             "tpu-train",
             [
                 tpu_test_avg_rule(
@@ -483,6 +494,7 @@ def adapter_values(
             adapter_rule("tpu_test_duty_cycle_avg"),
             adapter_rule("tpu_test_hbm_bw_avg"),
             adapter_rule("tpu_test_hbm_used_bytes", resource="pod"),
+            adapter_rule("tpu_serve_hbm_bw_avg"),
             adapter_rule("tpu_train_duty_cycle_avg"),
             adapter_rule("tpu_train_hbm_bw_avg"),
             adapter_rule("tpu_test_multihost_tensorcore_avg", resource="statefulset"),
@@ -521,6 +533,24 @@ def _tpu_test_v5e8_deployment() -> dict:
         tpu_limit=8,
         topology="2x4",
         container_name="tpu-test",
+    )
+
+
+def _tpu_serve_deployment() -> dict:
+    return workload_deployment(
+        "tpu-serve",
+        command=["python", "-m", "k8s_gpu_hpa_tpu.loadgen"],
+        env={
+            "WORKLOAD": "decode",
+            "DECODE_BATCH": "8",
+            "MAX_SEQ": "2048",
+            "D_MODEL": "512",
+            "N_LAYERS": "4",
+            "TPU_TEST_INTENSITY": "1.0",
+            "TPU_TEST_INTENSITY_FILE": INTENSITY_FILE,
+        },
+        tpu_limit=1,
+        topology="1x1",
     )
 
 
@@ -775,6 +805,17 @@ def default_bundle() -> dict[str, list[dict]]:
                             },
                         },
                     }
+                ],
+            )
+        ],
+        "tpu-serve-deployment.yaml": [_tpu_serve_deployment()],
+        "tpu-serve-hpa.yaml": [
+            hpa_manifest(
+                "tpu-serve",
+                metrics=[
+                    object_metric(
+                        "tpu_serve_hbm_bw_avg", "Deployment", "tpu-serve", "60"
+                    )
                 ],
             )
         ],

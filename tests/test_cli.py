@@ -63,6 +63,6 @@ def test_gen_manifests_check_passes_on_shipped_tree(capsys):
 def test_gen_manifests_writes_loadable_files(tmp_path):
     assert main(["gen-manifests", "-o", str(tmp_path)]) == 0
     files = list(tmp_path.glob("*.yaml"))
-    assert len(files) == 16
+    assert len(files) == 18
     for f in files:
         assert list(yaml.safe_load_all(f.read_text()))

@@ -95,6 +95,23 @@ def render() -> str:
         )
     )
     out.append(
+        "    # serving rung: KV-cache decode fleet, autoscaled on HBM\n"
+        "    # bandwidth (deploy/tpu-serve-hpa.yaml)\n"
+        "    - name: tpu-serve\n"
+        "      interval: 1s\n"
+        "      rules:\n"
+    )
+    out.append(
+        _render_rule(
+            tpu_test_avg_rule(
+                app="tpu-serve",
+                deployment="tpu-serve",
+                metric=TPU_HBM_BW_UTIL,
+                record="tpu_serve_hbm_bw_avg",
+            )
+        )
+    )
+    out.append(
         "    # training rung (BASELINE configs[3]): ResNet-50 training pod,\n"
         "    # multi-metric HPA on duty cycle + HBM bandwidth\n"
         "    - name: tpu-train\n"
