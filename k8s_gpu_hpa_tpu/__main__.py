@@ -98,7 +98,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
-    sub.add_parser("doctor", help="probe every pipeline joint in order")
+    doc = sub.add_parser("doctor", help="probe every pipeline joint in order")
+    doc.add_argument(
+        "--libtpu",
+        nargs="?",
+        const="localhost:8431",
+        default=None,
+        metavar="ADDR",
+        help="instead of the pipeline probes, validate the libtpu wire "
+        "contract against a live runtime-metrics server (default localhost:8431)",
+    )
     sub.add_parser("exporter", help="run the L2 metrics exporter daemon")
     sub.add_parser("loadgen", help="run the L1 matmul load generator")
     sub.add_parser("train", help="run the ResNet-50 training workload")
@@ -157,6 +166,10 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     if args.command == "doctor":
+        if args.libtpu:
+            from k8s_gpu_hpa_tpu.doctor import probe_libtpu
+
+            return probe_libtpu(args.libtpu)
         from k8s_gpu_hpa_tpu.doctor import main as doctor_main
 
         return doctor_main()

@@ -208,6 +208,109 @@ def diagnose(
     return results
 
 
+def probe_libtpu(address: str = "localhost:8431", timeout: float = 5.0) -> int:
+    """On-hardware fidelity check of the vendored libtpu wire contract
+    (proto/tpu_metric_service.proto): query a LIVE runtime-metrics server,
+    decode with the production parser, and print raw frame hex whenever a
+    decode looks wrong — the evidence needed to correct the vendored proto if
+    a libtpu build ever disagrees with it.  Exit 0 = contract validated."""
+    import grpc
+
+    from k8s_gpu_hpa_tpu.exporter import libtpu_proto
+
+    channel = grpc.insecure_channel(address)
+
+    def call(method: str, request: bytes) -> bytes:
+        rpc = channel.unary_unary(
+            method,
+            request_serializer=lambda req: req,
+            response_deserializer=lambda raw: raw,
+        )
+        return rpc(request, timeout=timeout)
+
+    failures = 0
+    validated = 0
+    try:
+        names = None
+        try:
+            raw = call(
+                libtpu_proto.LIST_SUPPORTED_METHOD,
+                libtpu_proto.encode_list_supported_request(),
+            )
+            try:
+                names = libtpu_proto.parse_list_supported_response(raw)
+            except Exception as e:  # undecodable frame IS the evidence
+                failures += 1
+                print(
+                    f"[FAIL] ListSupportedMetrics: response undecodable ({e}); "
+                    f"raw frame ({len(raw)}B): {raw.hex()}"
+                )
+            else:
+                print(
+                    f"[ok ] ListSupportedMetrics: {len(names)} metrics advertised"
+                )
+                for n in sorted(names):
+                    print(f"       {n}")
+                if names:
+                    validated += 1
+                else:
+                    failures += 1
+                    print(f"       raw frame ({len(raw)}B): {raw.hex()}")
+        except grpc.RpcError as e:
+            print(
+                f"[-- ] ListSupportedMetrics unavailable ({e.code().name}): "
+                "older libtpu build, probe-once fallback applies"
+            )
+        probe_names = sorted(names) if names else [
+            libtpu_proto.DUTY_CYCLE,
+            libtpu_proto.HBM_USAGE,
+            libtpu_proto.HBM_TOTAL,
+            libtpu_proto.HBM_BW,
+        ]
+        for name in probe_names:
+            try:
+                raw = call(
+                    libtpu_proto.GET_METRIC_METHOD,
+                    libtpu_proto.encode_metric_request(name),
+                )
+            except grpc.RpcError as e:
+                print(f"[-- ] {name}: RPC failed ({e.code().name})")
+                continue
+            try:
+                decoded = libtpu_proto.parse_metric_response(raw)
+            except Exception as e:
+                decoded = None
+                detail = f"response undecodable ({e})"
+            else:
+                detail = "response decoded to zero devices"
+            if decoded:
+                validated += 1
+                print(f"[ok ] {name}: {decoded}")
+            else:
+                failures += 1
+                print(
+                    f"[FAIL] {name}: {detail} — the vendored proto disagrees "
+                    f"with this libtpu build; raw frame ({len(raw)}B): "
+                    f"{raw.hex()}"
+                )
+    finally:
+        channel.close()
+    if failures:
+        print(
+            "\nwire-contract mismatch: attach the raw frames above to a bug "
+            "report against proto/tpu_metric_service.proto"
+        )
+        return 1
+    if not validated:
+        print(
+            "\nnothing validated: no RPC answered at "
+            f"{address} — is the runtime-metrics server running there?"
+        )
+        return 1
+    print("\nlibtpu wire contract validated against the live server")
+    return 0
+
+
 def _http_fetch(url: str) -> str:
     import urllib.request
 

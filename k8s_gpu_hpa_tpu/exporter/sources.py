@@ -15,6 +15,11 @@ list[ChipSample]``:
 - ``LibtpuSource``   — the production GKE path: gRPC to the libtpu
                        runtime-metrics service on localhost:8431 (the same
                        source ``tpu-info`` reads), decoded at the wire level.
+
+The wire contract lives in one place — ``exporter/libtpu_proto.py``, pinned to
+``proto/tpu_metric_service.proto`` via protoc-generated golden fixtures
+(``tests/fixtures/libtpu_golden/``); this module only re-exports the names its
+callers historically imported from here.
 """
 
 from __future__ import annotations
@@ -22,8 +27,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
+from k8s_gpu_hpa_tpu.exporter import libtpu_proto
 from k8s_gpu_hpa_tpu.metrics.schema import ChipSample
-from k8s_gpu_hpa_tpu.utils import protowire
 from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 
 
@@ -122,51 +127,16 @@ class JaxDeviceSource:
         return chips
 
 
-# libtpu runtime-metrics metric names (as surfaced by tpu-info / GKE docs).
-LIBTPU_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
-LIBTPU_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
-LIBTPU_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
-# Served by newer libtpu builds only; LibtpuSource degrades to 0 (and stops
-# asking) when the runtime answers with an error for this name.
-LIBTPU_HBM_BW = "tpu.runtime.hbm.bandwidth.utilization.percent"
+# Re-exports: the wire contract's single source of truth is libtpu_proto
+# (pinned to proto/tpu_metric_service.proto by protoc golden fixtures).
+LIBTPU_DUTY_CYCLE = libtpu_proto.DUTY_CYCLE
+LIBTPU_HBM_USAGE = libtpu_proto.HBM_USAGE
+LIBTPU_HBM_TOTAL = libtpu_proto.HBM_TOTAL
+# Served by newer libtpu builds only; LibtpuSource gates on
+# ListSupportedMetrics (probe-once fallback for builds without that RPC).
+LIBTPU_HBM_BW = libtpu_proto.HBM_BW
 
-
-def parse_metric_response(data: bytes) -> dict[int, float]:
-    """Extract {device_id: value} pairs from a libtpu MetricResponse.
-
-    Wire shape (decoded generically; unknown fields skipped):
-
-        MetricResponse { TPUMetric metric = 1; }
-        TPUMetric { string name = 1; repeated Metric metrics = 2; }
-        Metric { Attribute attribute = 1; Gauge gauge = 2; }
-        Attribute { string key = 1; AttrValue value = 2; }   # device-id holder
-        AttrValue { int64 int_attr = 2; }
-        Gauge { double as_double = 1; int64 as_int = 2; }
-
-    Structured this way so it is unit-testable from synthetic bytes; the
-    on-hardware shape is validated against a live libtpu on a GKE node.
-    """
-    out: dict[int, float] = {}
-    top = protowire.fields_by_number(data)
-    for tpu_metric in top.get(1, []):
-        for metric_blob in protowire.fields_by_number(tpu_metric).get(2, []):
-            fields = protowire.fields_by_number(metric_blob)
-            device_id = 0
-            for attr in fields.get(1, []):
-                attr_fields = protowire.fields_by_number(attr)
-                for value_blob in attr_fields.get(2, []):
-                    value_fields = protowire.fields_by_number(value_blob)
-                    if 2 in value_fields:
-                        device_id = int(value_fields[2][0])
-            value = 0.0
-            for gauge in fields.get(2, []):
-                gauge_fields = protowire.fields_by_number(gauge)
-                if 1 in gauge_fields:  # fixed64 double
-                    value = protowire.as_double(int(gauge_fields[1][0]))
-                elif 2 in gauge_fields:  # int64 varint
-                    value = float(int(gauge_fields[2][0]))
-            out[device_id] = value
-    return out
+parse_metric_response = libtpu_proto.parse_metric_response
 
 
 @dataclass
@@ -275,26 +245,68 @@ class LibtpuSource:
     _channel: object = field(default=None, repr=False)
     #: None = untested; probed on the first sweep, sticky afterwards
     _bw_supported: bool | None = field(default=None, repr=False)
+    #: metric names the runtime advertises via ListSupportedMetrics;
+    #: None = not yet asked or the RPC itself is unsupported (older libtpu)
+    _supported: set | None = field(default=None, repr=False)
+    _supported_probed: bool = field(default=False, repr=False)
 
     def _get_metric(self, name: str) -> dict[int, float]:
         call = self._channel.unary_unary(
-            "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric",
+            libtpu_proto.GET_METRIC_METHOD,
             request_serializer=lambda req: req,  # pre-encoded bytes
             response_deserializer=lambda raw: raw,
         )
-        request = protowire.encode_string(1, name)  # MetricRequest.metric_name
+        request = libtpu_proto.encode_metric_request(name)
         return parse_metric_response(call(request, timeout=self.timeout))
+
+    def supported_metrics(self) -> set | None:
+        """Metric names this libtpu build advertises, or None when the
+        ListSupportedMetrics RPC itself is unavailable (older builds — the
+        caller falls back to probe-once-per-name).  Asked once per channel
+        lifetime; capability sets don't change under a running libtpu."""
+        if self._supported_probed:
+            return self._supported
+        import grpc  # deferred, as in sample()
+
+        if self._channel is None:
+            self._channel = grpc.insecure_channel(self.address)
+        call = self._channel.unary_unary(
+            libtpu_proto.LIST_SUPPORTED_METHOD,
+            request_serializer=lambda req: req,
+            response_deserializer=lambda raw: raw,
+        )
+        try:
+            raw = call(
+                libtpu_proto.encode_list_supported_request(), timeout=self.timeout
+            )
+            self._supported = set(libtpu_proto.parse_list_supported_response(raw))
+        except Exception:
+            self._supported = None
+        self._supported_probed = True
+        return self._supported
 
     def close(self) -> None:
         if self._channel is not None:
             self._channel.close()
             self._channel = None
+        # a reconnect may reach a restarted (upgraded/downgraded) libtpu:
+        # re-ask the capability list and re-derive bandwidth support from it
+        self._supported_probed = False
+        self._supported = None
+        self._bw_supported = None
 
     def sample(self) -> list[ChipSample]:
         import grpc  # deferred: only the on-node daemon needs it
 
         if self._channel is None:
             self._channel = grpc.insecure_channel(self.address)
+        if self._bw_supported is None:
+            # Capability-gate optional metrics on the advertised list when the
+            # runtime has ListSupportedMetrics; older builds (RPC absent →
+            # supported_metrics() is None) keep the probe-once fallback below.
+            advertised = self.supported_metrics()
+            if advertised is not None and LIBTPU_HBM_BW not in advertised:
+                self._bw_supported = False
         try:
             duty = self._get_metric(LIBTPU_DUTY_CYCLE)
             usage = self._get_metric(LIBTPU_HBM_USAGE)
@@ -304,9 +316,9 @@ class LibtpuSource:
             raise
         bw: dict[int, float] = {}
         if self._bw_supported is not False:
-            # newer libtpu only: one failed probe marks it unsupported for the
-            # daemon's lifetime (don't pay a failing RPC every sweep), but a
-            # failure here must not discard the sweep we already have
+            # advertised (or unknown on older builds): one failed fetch marks
+            # it unsupported for the daemon's lifetime (don't pay a failing
+            # RPC every sweep), but a failure here must not discard the sweep
             try:
                 bw = self._get_metric(LIBTPU_HBM_BW)
                 self._bw_supported = True

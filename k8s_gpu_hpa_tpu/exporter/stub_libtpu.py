@@ -7,10 +7,12 @@ metrics server mimicking localhost:8431" so the exporter's libtpu path has
 tests that don't need a TPU node — the reference's dcgm-exporter has no such
 story for DCGM (its tests require a GPU driver).
 
-The stub serves the same method name and wire shape LibtpuSource consumes
-(`/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric`); values come
-from a ``metric_fn(metric_name, device_id) -> float`` so tests can script
-utilization curves per chip, like StubSource does for the in-process path.
+The stub serves the same methods and wire shape LibtpuSource consumes — both
+sides import the ONE codec in ``libtpu_proto`` (pinned to the vendored
+``proto/tpu_metric_service.proto`` by protoc golden fixtures), so the stub can
+no longer drift into a self-consistent invented schema.  Values come from a
+``metric_fn(metric_name, device_id) -> float`` so tests can script utilization
+curves per chip, like StubSource does for the in-process path.
 """
 
 from __future__ import annotations
@@ -18,48 +20,14 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
-from k8s_gpu_hpa_tpu.exporter import sources
-from k8s_gpu_hpa_tpu.utils import protowire
+from k8s_gpu_hpa_tpu.exporter import libtpu_proto, sources
 
-GET_METRIC_METHOD = (
-    "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric"
-)
+GET_METRIC_METHOD = libtpu_proto.GET_METRIC_METHOD
+LIST_SUPPORTED_METHOD = libtpu_proto.LIST_SUPPORTED_METHOD
 
-
-def decode_metric_request(data: bytes) -> str:
-    """MetricRequest.metric_name (field 1, string)."""
-    names = protowire.fields_by_number(data).get(1, [])
-    return names[0].decode() if names else ""
-
-
-def encode_metric_response(
-    name: str, per_device: dict[int, float], as_int: bool = False
-) -> bytes:
-    """Encode the MetricResponse wire shape parse_metric_response decodes:
-
-        MetricResponse { TPUMetric metric = 1; }
-        TPUMetric { string name = 1; repeated Metric metrics = 2; }
-        Metric { Attribute attribute = 1; Gauge gauge = 2; }
-        Attribute { string key = 1; AttrValue value = 2; }
-        AttrValue { int64 int_attr = 2; }
-        Gauge { double as_double = 1; int64 as_int = 2; }
-    """
-    metrics = b""
-    for device_id, value in sorted(per_device.items()):
-        attr_value = protowire.encode_uint(2, device_id)
-        attribute = protowire.encode_string(1, "device-id") + protowire.encode_string(
-            2, attr_value
-        )
-        if as_int:
-            gauge = protowire.encode_uint(2, int(value))
-        else:
-            gauge = protowire.encode_double(1, float(value))
-        metric = protowire.encode_string(1, attribute) + protowire.encode_string(
-            2, gauge
-        )
-        metrics += protowire.encode_string(2, metric)
-    tpu_metric = protowire.encode_string(1, name) + metrics
-    return protowire.encode_string(1, tpu_metric)
+# Re-exported codec entry points (tests and older callers import them here).
+decode_metric_request = libtpu_proto.decode_metric_request
+encode_metric_response = libtpu_proto.encode_metric_response
 
 
 @dataclass
@@ -79,6 +47,12 @@ class StubLibtpuServer:
     #: explicit global chip ids (default range(num_chips)) — lets tests model
     #: several per-process servers each owning different chips of one host
     device_ids: list[int] | None = None
+    #: names advertised by ListSupportedMetrics (default: the four standard
+    #: families); tests override to model builds with/without optional metrics
+    supported_metrics: list[str] | None = None
+    #: False models older libtpu builds where the ListSupportedMetrics RPC
+    #: itself is absent (client must fall back to probe-once-per-name)
+    list_supported_enabled: bool = True
 
     def _value(self, name: str, device_id: int) -> float:
         if self.metric_fn is not None:
@@ -101,15 +75,33 @@ class StubLibtpuServer:
         as_int = name in (sources.LIBTPU_HBM_USAGE, sources.LIBTPU_HBM_TOTAL)
         return encode_metric_response(name, per_device, as_int=as_int)
 
+    def _handle_list(self, request: bytes, context) -> bytes:
+        names = self.supported_metrics
+        if names is None:
+            names = [
+                sources.LIBTPU_DUTY_CYCLE,
+                sources.LIBTPU_HBM_USAGE,
+                sources.LIBTPU_HBM_TOTAL,
+                sources.LIBTPU_HBM_BW,
+            ]
+        return libtpu_proto.encode_list_supported_response(list(names))
+
     def start(self) -> "StubLibtpuServer":
         import grpc
 
         class Handler(grpc.GenericRpcHandler):
             def service(handler_self, call_details):
-                if call_details.method != GET_METRIC_METHOD:
+                if call_details.method == GET_METRIC_METHOD:
+                    handler_fn = self._handle
+                elif (
+                    call_details.method == LIST_SUPPORTED_METHOD
+                    and self.list_supported_enabled
+                ):
+                    handler_fn = self._handle_list
+                else:
                     return None
                 return grpc.unary_unary_rpc_method_handler(
-                    self._handle,
+                    handler_fn,
                     request_deserializer=lambda raw: raw,
                     response_serializer=lambda raw: raw,
                 )

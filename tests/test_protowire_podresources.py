@@ -116,18 +116,29 @@ def test_parse_list_response_empty():
 
 
 def make_metric(device_id, value, as_int=False):
+    # Field numbers per the vendored proto/tpu_metric_service.proto: Metric is
+    # { attribute=1, timestamp=2, gauge=3 }; a timestamp is included so the
+    # parser proves it skips field 2 rather than misreading it as the gauge.
     attr_value = encode_varint_field(2, device_id)
     attribute = encode_string(1, "device-id") + encode_message(2, attr_value)
+    timestamp = encode_varint_field(1, 1753747200)
     gauge = (
         encode_varint_field(2, int(value)) if as_int else encode_double_field(1, value)
     )
-    return encode_message(1, attribute) + encode_message(2, gauge)
+    return (
+        encode_message(1, attribute)
+        + encode_message(2, timestamp)
+        + encode_message(3, gauge)
+    )
 
 
 def test_parse_metric_response_doubles_and_ints():
+    # TPUMetric is { name=1, description=2, metrics=3 } — description present
+    # so the parser proves it skips field 2 (round 1 misread it as a Metric).
     tpu_metric = encode_string(1, "tpu.runtime.tensorcore.dutycycle.percent")
-    tpu_metric += encode_message(2, make_metric(0, 73.5))
-    tpu_metric += encode_message(2, make_metric(1, 16_000_000_000, as_int=True))
+    tpu_metric += encode_string(2, "TensorCore duty cycle percentage")
+    tpu_metric += encode_message(3, make_metric(0, 73.5))
+    tpu_metric += encode_message(3, make_metric(1, 16_000_000_000, as_int=True))
     resp = encode_message(1, tpu_metric)
     assert parse_metric_response(resp) == {0: 73.5, 1: 16_000_000_000.0}
 

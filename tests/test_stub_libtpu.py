@@ -149,6 +149,40 @@ def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
             source.close()
 
 
+def test_bandwidth_gated_off_by_supported_metrics_list():
+    """When the runtime advertises its metric set and bandwidth is absent,
+    the client must not burn a failing GetRuntimeMetric probing it."""
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
+    with StubLibtpuServer(
+        num_chips=2,
+        supported_metrics=[LIBTPU_DUTY_CYCLE, LIBTPU_HBM_USAGE, LIBTPU_HBM_TOTAL],
+    ) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert source._bw_supported is False
+            assert all(c.hbm_bw_util == 0.0 for c in chips)
+            source.sample()
+            assert server.request_log.count(LIBTPU_HBM_BW) == 0  # never asked
+        finally:
+            source.close()
+
+
+def test_supported_metrics_rpc_absent_falls_back_to_probe():
+    """Older libtpu without ListSupportedMetrics: supported_metrics() is None
+    and the probe-once-per-name behavior carries the sweep."""
+    with StubLibtpuServer(num_chips=1, list_supported_enabled=False) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            assert source.supported_metrics() is None
+            chips = source.sample()
+            assert len(chips) == 1
+            assert source._bw_supported is True  # default stub serves bw
+        finally:
+            source.close()
+
+
 def test_merged_source_unions_per_process_servers():
     """A node with several TPU pods runs one runtime-metrics server per
     process; the merged source must see every pod's chips."""

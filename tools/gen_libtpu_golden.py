@@ -1,0 +1,177 @@
+"""Generate golden libtpu wire fixtures from the vendored proto via protoc.
+
+Pins the libtpu runtime-metrics wire contract (proto/tpu_metric_service.proto)
+with an encoder INDEPENDENT of this repo's hand-rolled codec: protoc compiles
+the vendored proto and protobuf's canonical serializer produces the bytes.
+``tests/test_libtpu_proto.py`` then asserts:
+
+  - ``libtpu_proto.parse_metric_response`` decodes every fixture to the
+    manifest's expected values (production parser vs canonical encoder), and
+  - ``libtpu_proto.encode_metric_response`` reproduces the fixture bytes
+    exactly for encoder-parity cases (stub server vs canonical encoder),
+
+closing the round-1 circularity where stub and parser shared one invented
+schema.  Run from the repo root; rewrites tests/fixtures/libtpu_golden/.
+
+    python tools/gen_libtpu_golden.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PROTO = REPO / "proto" / "tpu_metric_service.proto"
+OUT_DIR = REPO / "tests" / "fixtures" / "libtpu_golden"
+
+# One fixed timestamp for every fixture (fixtures must be byte-stable).
+FIXED_TS = 1753747200  # 2025-07-29T00:00:00Z
+
+CASES = [
+    {
+        "file": "duty_cycle_4chips.bin",
+        "kind": "metric_response",
+        "metric_name": "tpu.runtime.tensorcore.dutycycle.percent",
+        "description": "TensorCore duty cycle percentage",
+        "per_device": {0: 37.5, 1: 62.25, 2: 0.0, 3: 100.0},
+        "as_int": False,
+        "timestamp_s": FIXED_TS,
+        "encoder_parity": True,
+    },
+    {
+        "file": "hbm_usage_8chips.bin",
+        "kind": "metric_response",
+        "metric_name": "tpu.runtime.hbm.memory.usage.bytes",
+        "description": "HBM memory usage in bytes",
+        "per_device": {i: float(1 << (30 + i % 4)) for i in range(8)},
+        "as_int": True,
+        "timestamp_s": FIXED_TS,
+        "encoder_parity": True,
+    },
+    {
+        "file": "hbm_total_1chip.bin",
+        "kind": "metric_response",
+        "metric_name": "tpu.runtime.hbm.memory.total.bytes",
+        "description": "",
+        "per_device": {0: 17179869184.0},
+        "as_int": True,
+        "timestamp_s": 0,
+        "encoder_parity": True,
+    },
+    {
+        "file": "hbm_bw_4chips.bin",
+        "kind": "metric_response",
+        "metric_name": "tpu.runtime.hbm.bandwidth.utilization.percent",
+        "description": "HBM bandwidth utilization percentage",
+        "per_device": {0: 12.5, 1: 50.0, 2: 87.5, 3: 99.875},
+        "as_int": False,
+        "timestamp_s": FIXED_TS,
+        "encoder_parity": True,
+    },
+    {
+        # Defensive shape: measurement present but no device-id attribute —
+        # parser must land it on device 0, not crash.  Encoder parity is off
+        # (our encoder always writes the attribute, as libtpu does).
+        "file": "no_device_attr.bin",
+        "kind": "metric_response_no_attr",
+        "metric_name": "tpu.runtime.tensorcore.dutycycle.percent",
+        "description": "",
+        "per_device": {0: 55.0},
+        "as_int": False,
+        "timestamp_s": FIXED_TS,
+        "encoder_parity": False,
+    },
+    {
+        "file": "list_supported.bin",
+        "kind": "list_supported",
+        "names": [
+            "tpu.runtime.tensorcore.dutycycle.percent",
+            "tpu.runtime.hbm.memory.usage.bytes",
+            "tpu.runtime.hbm.memory.total.bytes",
+            "tpu.runtime.hbm.bandwidth.utilization.percent",
+        ],
+        "encoder_parity": True,
+    },
+]
+
+
+def compile_proto(tmp: pathlib.Path):
+    subprocess.run(
+        [
+            "protoc",
+            f"--proto_path={PROTO.parent}",
+            f"--python_out={tmp}",
+            PROTO.name,
+        ],
+        check=True,
+    )
+    spec = importlib.util.spec_from_file_location(
+        "tpu_metric_service_pb2", tmp / "tpu_metric_service_pb2.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tpu_metric_service_pb2"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_metric_response(pb2, case) -> bytes:
+    resp = pb2.MetricResponse()
+    resp.metric.name = case["metric_name"]
+    if case["description"]:
+        resp.metric.description = case["description"]
+    for device_id in sorted(case["per_device"]):
+        value = case["per_device"][device_id]
+        m = resp.metric.metrics.add()
+        if case["kind"] != "metric_response_no_attr":
+            m.attribute.key = "device-id"
+            m.attribute.value.int_attr = device_id
+        if case["timestamp_s"]:
+            m.timestamp.seconds = case["timestamp_s"]
+        if case["as_int"]:
+            m.gauge.as_int = int(value)
+        else:
+            m.gauge.as_double = float(value)
+    return resp.SerializeToString(deterministic=True)
+
+
+def build_list_supported(pb2, case) -> bytes:
+    resp = pb2.ListSupportedMetricsResponse()
+    for name in case["names"]:
+        resp.supported_metric.add().metric_name = name
+    return resp.SerializeToString(deterministic=True)
+
+
+def main() -> None:
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        pb2 = compile_proto(pathlib.Path(tmp))
+        for case in CASES:
+            if case["kind"] == "list_supported":
+                raw = build_list_supported(pb2, case)
+            else:
+                raw = build_metric_response(pb2, case)
+            (OUT_DIR / case["file"]).write_bytes(raw)
+            print(f"wrote {case['file']}: {len(raw)} bytes")
+    manifest = {
+        "provenance": (
+            "Serialized by protobuf's canonical encoder from "
+            "proto/tpu_metric_service.proto (vendored reconstruction of the "
+            "public tpu-info proto; see that file's header) via "
+            "tools/gen_libtpu_golden.py. protoc "
+            + subprocess.run(
+                ["protoc", "--version"], capture_output=True, text=True
+            ).stdout.strip()
+        ),
+        "cases": CASES,
+    }
+    (OUT_DIR / "manifest.json").write_text(json.dumps(manifest, indent=2) + "\n")
+    print(f"wrote manifest.json ({len(CASES)} cases)")
+
+
+if __name__ == "__main__":
+    main()
