@@ -202,7 +202,17 @@ def main() -> None:
     # don't let a stray intensity file override the commanded duty cycle
     gen.intensity_file = f"/tmp/bench-intensity-{id(gen)}"
     gen.warmup()
-    source = JaxDeviceSource(util_fn=lambda i: gen.utilization())
+    if gen.peak_tflops is None:
+        # CPU smoke fallback: no public peak for this backend — calibrate a
+        # synthetic one from a full-tilt burst so the tensorcore series
+        # exists and tracks duty cycle (on TPU the real peak is used)
+        gen.step()
+        gen.peak_tflops = max(gen.stats().achieved_tflops, 1e-9)
+    # duty cycle (busy fraction) and genuine MXU rate, distinct by design
+    source = JaxDeviceSource(
+        util_fn=lambda i: gen.utilization(),
+        mxu_fn=lambda i: gen.mxu_utilization(),
+    )
     daemon = ExporterDaemon(
         source,
         StaticAttributor({0: ("default", "tpu-test-real")}),

@@ -76,7 +76,8 @@ int main(int argc, char** argv) {
         double util = 50.0 + 45.0 * std::sin(t / 30.0 + i);
         chips.push_back(TpuChipSample{i, util, std::fmin(100.0, util * 1.1),
                                       0.5e9 + 15.5e9 * util / 100.0, 16e9,
-                                      util * 0.6});
+                                      util * 0.6, 35.0 + util * 0.3,
+                                      60.0 + util * 1.4});
       }
       tpu_exporter_push_samples(ex, chips.data(), (int32_t)chips.size());
       usleep(static_cast<useconds_t>(collect_ms) * 1000);
@@ -85,11 +86,18 @@ int main(int argc, char** argv) {
   } else {  // stdin
     std::vector<TpuChipSample> chips;
     char line[256];
+    const double kNan = std::nan("");
     while (fgets(line, sizeof(line), stdin)) {
       TpuChipSample s{};
-      if (sscanf(line, "%d %lf %lf %lf %lf %lf", &s.accel_index,
-                 &s.tensorcore_util, &s.duty_cycle, &s.hbm_usage_bytes,
-                 &s.hbm_total_bytes, &s.hbm_bw_util) == 6) {
+      // temp/power are optional trailing fields; absent -> NaN (omitted from
+      // the exposition), matching the schema's "can't measure" semantics.
+      s.temperature_c = kNan;
+      s.power_w = kNan;
+      int parsed = sscanf(line, "%d %lf %lf %lf %lf %lf %lf %lf",
+                          &s.accel_index, &s.tensorcore_util, &s.duty_cycle,
+                          &s.hbm_usage_bytes, &s.hbm_total_bytes,
+                          &s.hbm_bw_util, &s.temperature_c, &s.power_w);
+      if (parsed >= 6) {
         chips.push_back(s);
       } else if (!chips.empty()) {  // blank/invalid line flushes the sweep
         tpu_exporter_push_samples(ex, chips.data(), (int32_t)chips.size());

@@ -72,14 +72,21 @@ struct MetricDef {
 
 // Order and metadata mirror k8s_gpu_hpa_tpu/metrics/schema.py::CHIP_METRICS.
 constexpr MetricDef kChipMetrics[] = {
-    {"tpu_tensorcore_utilization", "TensorCore utilization percent per TPU chip"},
+    {"tpu_tensorcore_utilization",
+     "Achieved/peak MXU FLOPs percent per TPU chip (workload-reported)"},
     {"tpu_duty_cycle", "Accelerator duty cycle percent per TPU chip"},
     {"tpu_hbm_memory_usage_bytes", "HBM memory used in bytes per TPU chip"},
     {"tpu_hbm_memory_total_bytes", "Total HBM memory in bytes per TPU chip"},
     {"tpu_hbm_memory_bandwidth_utilization",
      "HBM bandwidth utilization percent per TPU chip"},
+    {"tpu_chip_temperature_celsius", "Chip temperature in Celsius per TPU chip"},
+    {"tpu_chip_power_watts", "Chip power draw in watts per TPU chip"},
 };
+constexpr int kNumChipMetrics =
+    static_cast<int>(sizeof(kChipMetrics) / sizeof(kChipMetrics[0]));
 
+// NaN = "unmeasurable on this source" — the sample is omitted (absent series),
+// matching ChipSample's None semantics across the ctypes ABI.
 double MetricValue(const TpuChipSample& s, int metric_idx) {
   switch (metric_idx) {
     case 0: return s.tensorcore_util;
@@ -87,6 +94,8 @@ double MetricValue(const TpuChipSample& s, int metric_idx) {
     case 2: return s.hbm_usage_bytes;
     case 3: return s.hbm_total_bytes;
     case 4: return s.hbm_bw_util;
+    case 5: return s.temperature_c;
+    case 6: return s.power_w;
   }
   return 0.0;
 }
@@ -97,9 +106,17 @@ struct TpuExporter {
   std::string node_name;
   int64_t staleness_ms;
 
+  struct QueueGauge {
+    std::string queue;
+    std::string ns;
+    std::string pod;
+    double depth;
+  };
+
   std::mutex mu;
   std::vector<TpuChipSample> samples;               // guarded by mu
   std::map<int32_t, std::pair<std::string, std::string>> attribution;  // mu
+  std::vector<QueueGauge> queue_gauges;             // guarded by mu
   int64_t last_push_ms = -1;                        // guarded by mu
   uint64_t push_count = 0;                          // guarded by mu
 
@@ -143,7 +160,14 @@ struct TpuExporter {
            std::to_string(request_count.load(std::memory_order_relaxed)) + "\n";
     if (!fresh) return out;  // withhold stale chip gauges entirely
 
-    for (int m = 0; m < 5; ++m) {
+    for (int m = 0; m < kNumChipMetrics; ++m) {
+      // NaN samples are "unmeasurable here" — omitted; a family where every
+      // chip is NaN renders nothing at all (absent series, not HELP-only).
+      bool any = false;
+      for (const TpuChipSample& s : samples) {
+        if (!std::isnan(MetricValue(s, m))) { any = true; break; }
+      }
+      if (!any) continue;
       out += "# HELP ";
       out += kChipMetrics[m].name;
       out += " ";
@@ -152,6 +176,8 @@ struct TpuExporter {
       out += kChipMetrics[m].name;
       out += " gauge\n";
       for (const TpuChipSample& s : samples) {
+        double v = MetricValue(s, m);
+        if (std::isnan(v)) continue;
         std::string ns, pod;
         auto it = attribution.find(s.accel_index);
         if (it != attribution.end()) {
@@ -163,7 +189,19 @@ struct TpuExporter {
         out += ",namespace=\"" + EscapeLabel(ns) + "\"";
         out += ",node=\"" + EscapeLabel(node_name) + "\"";
         out += ",pod=\"" + EscapeLabel(pod) + "\"} ";
-        out += FormatValue(MetricValue(s, m));
+        out += FormatValue(v);
+        out += "\n";
+      }
+    }
+    if (!queue_gauges.empty()) {
+      out += "# HELP tpu_test_queue_depth Pending requests in the workload's serving queue\n";
+      out += "# TYPE tpu_test_queue_depth gauge\n";
+      for (const QueueGauge& q : queue_gauges) {
+        out += "tpu_test_queue_depth{namespace=\"" + EscapeLabel(q.ns) + "\"";
+        out += ",node=\"" + EscapeLabel(node_name) + "\"";
+        out += ",pod=\"" + EscapeLabel(q.pod) + "\"";
+        out += ",queue=\"" + EscapeLabel(q.queue) + "\"} ";
+        out += FormatValue(q.depth);
         out += "\n";
       }
     }
@@ -323,6 +361,22 @@ void tpu_exporter_replace_attribution(TpuExporter* ex, const int32_t* indices,
   }
   std::lock_guard<std::mutex> lock(ex->mu);
   ex->attribution.swap(next);
+}
+
+void tpu_exporter_replace_queue_gauges(TpuExporter* ex,
+                                       const char* const* queues,
+                                       const char* const* namespaces,
+                                       const char* const* pods,
+                                       const double* depths, int32_t n) {
+  // Build outside the lock, swap under it (same pattern as attribution).
+  std::vector<TpuExporter::QueueGauge> next;
+  next.reserve(n > 0 ? n : 0);
+  for (int32_t i = 0; i < n; ++i) {
+    next.push_back({queues[i] ? queues[i] : "", namespaces[i] ? namespaces[i] : "",
+                    pods[i] ? pods[i] : "", depths[i]});
+  }
+  std::lock_guard<std::mutex> lock(ex->mu);
+  ex->queue_gauges.swap(next);
 }
 
 int64_t tpu_exporter_render(TpuExporter* ex, char* buf, int64_t buflen) {

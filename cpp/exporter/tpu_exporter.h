@@ -29,14 +29,19 @@ extern "C" {
 typedef struct TpuExporter TpuExporter;
 
 // One reading of every per-chip gauge (schema mirror of
-// k8s_gpu_hpa_tpu/metrics/schema.py::ChipSample).
+// k8s_gpu_hpa_tpu/metrics/schema.py::ChipSample).  NaN in any field means
+// "this source cannot measure the quantity": the renderer OMITS the sample,
+// so the series is absent from /metrics rather than a fake 0 (one name, one
+// meaning — schema.py's source table).
 typedef struct {
   int32_t accel_index;
-  double tensorcore_util;   // percent 0-100
+  double tensorcore_util;   // percent 0-100, achieved/peak MXU FLOPs
   double duty_cycle;        // percent 0-100
   double hbm_usage_bytes;
   double hbm_total_bytes;
   double hbm_bw_util;       // percent 0-100
+  double temperature_c;     // degrees Celsius
+  double power_w;           // watts
 } TpuChipSample;
 
 // Create an exporter. `node_name` is stamped on every sample (the analog of the
@@ -69,6 +74,18 @@ void tpu_exporter_clear_attribution(TpuExporter* ex);
 void tpu_exporter_replace_attribution(TpuExporter* ex, const int32_t* indices,
                                       const char* const* namespaces,
                                       const char* const* pods, int32_t n);
+
+// Atomically replace the per-pod serving-queue gauges (parallel arrays of
+// length n).  Rendered as the workload-level series
+//   tpu_test_queue_depth{namespace,node,pod,queue} <depth>
+// — the External-metric rung's demand signal, self-reported by serving
+// workloads (loadgen/decode.py) via the telemetry channel and subject to the
+// same freshness window as chip samples (stale sweeps withhold it).
+void tpu_exporter_replace_queue_gauges(TpuExporter* ex,
+                                       const char* const* queues,
+                                       const char* const* namespaces,
+                                       const char* const* pods,
+                                       const double* depths, int32_t n);
 
 // Render the Prometheus text exposition into buf.  Returns the number of bytes
 // written (excluding the NUL terminator), or the negative required size if

@@ -12,6 +12,11 @@ from __future__ import annotations
 
 from k8s_gpu_hpa_tpu.exporter.native import NativeExporter
 from k8s_gpu_hpa_tpu.exporter.podresources import Attributor
+from k8s_gpu_hpa_tpu.exporter.selfreport import (
+    SelfReportReader,
+    filter_to_attribution,
+    merge_reports,
+)
 from k8s_gpu_hpa_tpu.exporter.sources import MetricsSource
 from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 
@@ -27,9 +32,11 @@ class ExporterDaemon:
         collect_interval: float = 1.0,
         attribution_interval: float = 10.0,
         clock: Clock | None = None,
+        selfreport: SelfReportReader | None = None,
     ):
         self.source = source
         self.attributor = attributor
+        self.selfreport = selfreport
         self.collect_interval = collect_interval
         self.attribution_interval = attribution_interval
         self.clock = clock or SystemClock()
@@ -41,6 +48,7 @@ class ExporterDaemon:
             staleness_ms=int(collect_interval * 3000),
         )
         self._last_attribution = -float("inf")
+        self._attribution: dict[int, tuple[str, str]] = {}
         self.sweeps = 0
 
     @property
@@ -55,12 +63,33 @@ class ExporterDaemon:
             and now - self._last_attribution >= self.attribution_interval
         ):
             try:
-                self.native.set_attribution(self.attributor.list_allocations())
+                allocations = self.attributor.list_allocations()
+                self.native.set_attribution(allocations)
+                self._attribution = allocations
                 self._last_attribution = now
             except Exception:
                 pass  # kubelet briefly unavailable: keep last mapping
         try:
-            self.native.push(self.source.sample())
+            chips = self.source.sample()
+            if self.selfreport is not None:
+                # fill gauges only the workload can measure (tensorcore MXU
+                # rate; bw fallback), gated by kubelet attribution: a report
+                # claiming an identity the kubelet doesn't place on this node
+                # paints nothing — including queue gauges
+                reports = filter_to_attribution(
+                    self.selfreport.read(), self._attribution
+                )
+                chips = merge_reports(chips, self._attribution, reports)
+                # per-pod serving-queue depth (the External rung's demand
+                # signal, tpu_test_queue_depth{queue=...})
+                self.native.set_queue_gauges(
+                    [
+                        (r.queue, r.namespace, r.pod, r.queue_depth)
+                        for r in reports.values()
+                        if r.queue_depth is not None and r.queue
+                    ]
+                )
+            self.native.push(chips)
             self.sweeps += 1
         except Exception:
             pass  # source hiccup: freshness watchdog flips `up` to 0
@@ -127,12 +156,19 @@ def main() -> None:
         source = MergedLibtpuSource.from_env()
         attributor = PodResourcesClient()
 
+    # Workload self-telemetry (TPU_TELEMETRY_DIR hostPath, mounted by the
+    # shipped manifests): supplies the gauges device counters can't —
+    # tensorcore MXU rate always, HBM bandwidth on libtpu builds without it.
+    telemetry_dir = os.environ.get("TPU_TELEMETRY_DIR", "")
+    selfreport = SelfReportReader(telemetry_dir) if telemetry_dir else None
+
     daemon = ExporterDaemon(
         source,
         attributor=attributor,
         node_name=os.environ.get("NODE_NAME", "unknown-node"),
         port=int(os.environ.get("LISTEN_PORT", "9400")),
         collect_interval=float(os.environ.get("COLLECT_MS", "1000")) / 1000.0,
+        selfreport=selfreport,
     )
     daemon.run_forever()
 

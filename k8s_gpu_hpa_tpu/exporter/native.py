@@ -20,6 +20,8 @@ _LIB_PATH = _BUILD_DIR / "libtpu_exporter.so"
 
 
 class _CChipSample(ctypes.Structure):
+    # None ("source cannot measure this") crosses the ABI as NaN; the C++
+    # renderer omits NaN samples so the series is absent, not a fake 0.
     _fields_ = [
         ("accel_index", ctypes.c_int32),
         ("tensorcore_util", ctypes.c_double),
@@ -27,7 +29,16 @@ class _CChipSample(ctypes.Structure):
         ("hbm_usage_bytes", ctypes.c_double),
         ("hbm_total_bytes", ctypes.c_double),
         ("hbm_bw_util", ctypes.c_double),
+        ("temperature_c", ctypes.c_double),
+        ("power_w", ctypes.c_double),
     ]
+
+
+_NAN = float("nan")
+
+
+def _opt(value: float | None) -> float:
+    return _NAN if value is None else value
 
 
 def build_native(force: bool = False) -> Path:
@@ -71,6 +82,14 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.c_int32,
         ]
+        lib.tpu_exporter_replace_queue_gauges.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+        ]
         lib.tpu_exporter_render.restype = ctypes.c_int64
         lib.tpu_exporter_render.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
@@ -110,11 +129,13 @@ class NativeExporter:
             *[
                 _CChipSample(
                     c.accel_index,
-                    c.tensorcore_util,
-                    c.duty_cycle,
+                    _opt(c.tensorcore_util),
+                    _opt(c.duty_cycle),
                     c.hbm_usage_bytes,
                     c.hbm_total_bytes,
-                    c.hbm_bw_util,
+                    _opt(c.hbm_bw_util),
+                    _opt(c.temperature_c),
+                    _opt(c.power_w),
                 )
                 for c in chips
             ]
@@ -130,6 +151,20 @@ class NativeExporter:
         pods = (ctypes.c_char_p * n)(*[pod.encode() for _, pod in mapping.values()])
         self._lib.tpu_exporter_replace_attribution(
             self._handle, indices, namespaces, pods, n
+        )
+
+    def set_queue_gauges(
+        self, gauges: list[tuple[str, str, str, float]]
+    ) -> None:
+        """Atomically replace the per-pod serving-queue gauges; each entry is
+        (queue, namespace, pod, depth) → tpu_test_queue_depth samples."""
+        n = len(gauges)
+        queues = (ctypes.c_char_p * n)(*[q.encode() for q, _, _, _ in gauges])
+        namespaces = (ctypes.c_char_p * n)(*[ns.encode() for _, ns, _, _ in gauges])
+        pods = (ctypes.c_char_p * n)(*[p.encode() for _, _, p, _ in gauges])
+        depths = (ctypes.c_double * n)(*[d for _, _, _, d in gauges])
+        self._lib.tpu_exporter_replace_queue_gauges(
+            self._handle, queues, namespaces, pods, depths, n
         )
 
     def render(self) -> str:

@@ -9,6 +9,19 @@ generator produces it honestly where the matmul busy-loop cannot.
 Greedy decode keeps everything on-device: the sampled token feeds the next
 step inside one ``lax.fori_loop`` dispatch (``tokens_per_burst`` steps per
 host round-trip, same dispatch-amortization as every other generator).
+
+Two self-reported signals feed the pipeline where device counters can't:
+
+- **achieved HBM bandwidth** — each decode token-step streams the full static
+  KV cache plus the weights (static shapes under ``jit``: XLA reads the whole
+  padded cache every step), so bytes/s is known exactly; divided by the
+  chip's public peak (matmul.PEAK_HBM_GBPS) it becomes the
+  ``tpu_hbm_memory_bandwidth_utilization`` fallback on libtpu builds without
+  the bandwidth counter (VERDICT.md weak #3).
+- **queue depth** — a request queue sits in front of the worker (offered-load
+  generator → queue → decode bursts), exported as ``tpu_test_queue_depth``,
+  the External-metric rung's demand signal (VERDICT.md weak #4: round 1
+  shipped the consumer contract with no producer).
 """
 
 from __future__ import annotations
@@ -20,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from k8s_gpu_hpa_tpu.loadgen.matmul import peak_hbm_gbps_for
 from k8s_gpu_hpa_tpu.models.transformer import (
     TransformerConfig,
     decode_step,
@@ -35,10 +49,50 @@ class DecodeStats:
     tokens_per_sec: float
     cache_bytes: int
     seconds: float
+    achieved_gbps: float  # bytes streamed / busy second
+    hbm_bw_util_pct: float | None  # achieved/peak, None off-TPU
+    utilization_pct: float  # busy fraction of wall time (duty cycle)
+
+
+class RequestQueue:
+    """Offered-load generator → queue → worker, in one process.
+
+    Arrivals accumulate continuously (``offered_rps × dt``, fractional);
+    the decode worker takes up to ``batch`` requests per burst.  ``depth`` is
+    the demand signal the External HPA divides by replicas (AverageValue
+    semantics: target 100 = "one replica per 100 queued requests",
+    deploy/tpu-test-external-hpa.yaml)."""
+
+    def __init__(self, max_depth: float = 1e6):
+        self._depth = 0.0
+        self.max_depth = max_depth
+        self.offered_total = 0.0
+        self.served_total = 0.0
+
+    @property
+    def depth(self) -> float:
+        return self._depth
+
+    def offer(self, requests: float) -> None:
+        requests = max(0.0, requests)
+        self.offered_total += requests
+        self._depth = min(self.max_depth, self._depth + requests)
+
+    def take(self, up_to: float) -> float:
+        served = min(self._depth, max(0.0, up_to))
+        self._depth -= served
+        self.served_total += served
+        return served
 
 
 class DecodeLoadGen:
-    """Busy-loop of greedy KV-cache decode bursts on the local device."""
+    """Busy-loop of greedy KV-cache decode bursts on the local device.
+
+    Windowed accounting (``window`` seconds, like MatmulLoadGen): utilization
+    and bandwidth are rates over the recent wall clock, so an idle worker
+    decays to 0 instead of reporting its historical average forever — the
+    serve HPA must see demand drop to scale in.
+    """
 
     def __init__(
         self,
@@ -49,7 +103,9 @@ class DecodeLoadGen:
         n_layers: int = 4,
         tokens_per_burst: int | None = None,
         dtype=jnp.bfloat16,
+        window: float = 10.0,
     ):
+        self.window = window
         self.cfg = TransformerConfig(
             d_model=d_model,
             n_heads=n_heads,
@@ -85,9 +141,24 @@ class DecodeLoadGen:
         self._burst = jax.jit(burst)
         self._steps = 0
         self._busy = 0.0
+        #: (t, busy_seconds) recent bursts, pruned to the window
+        self._history: list[tuple[float, float]] = []
+        self._param_bytes = sum(
+            arr.size * arr.dtype.itemsize for arr in jax.tree.leaves(self._params)
+        )
+        self.peak_hbm_gbps = peak_hbm_gbps_for(jax.devices()[0])
 
     def warmup(self) -> None:
         self._run_burst()
+        # accounting starts after compile (compile time is not load)
+        self._steps = 0
+        self._busy = 0.0
+        self._history = []
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window
+        while self._history and self._history[0][0] < cutoff:
+            self._history.pop(0)
 
     def _run_burst(self) -> None:
         self._tokens, self._cache, self._pos = self._burst(
@@ -99,9 +170,12 @@ class DecodeLoadGen:
     def step(self) -> float:
         t0 = time.perf_counter()
         self._run_burst()
-        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        dt = now - t0
         self._busy += dt
         self._steps += 1
+        self._history.append((now, dt))
+        self._prune(now)
         return dt
 
     def stats(self) -> DecodeStats:
@@ -109,22 +183,57 @@ class DecodeLoadGen:
         cache_bytes = sum(
             arr.size * arr.dtype.itemsize for arr in self._cache.values()
         )
+        now = time.perf_counter()
+        self._prune(now)
+        # Windowed rates: bytes streamed per token-step is the full static KV
+        # cache (attention reads every padded position under jit's static
+        # shapes) + weights — exact by construction.  Rates divide by WALL
+        # time over the window, so an idle worker decays to 0 within
+        # ``window`` seconds instead of freezing at its historical average
+        # (the load-insensitivity trap: busy-time rates are ~constant for a
+        # memory-bound kernel regardless of offered demand).
+        win_busy = sum(b for _, b in self._history)
+        win_bursts = len(self._history)
+        bytes_per_burst = self.tokens_per_burst * (cache_bytes + self._param_bytes)
+        if self._history:
+            wall = max(now - self._history[0][0], win_busy, 1e-9)
+        else:
+            wall = 1.0  # empty window: all rates are exactly 0 below
+        sustained_gbps = win_bursts * bytes_per_burst / wall / 1e9
+        achieved_gbps = (
+            win_bursts * bytes_per_burst / win_busy / 1e9 if win_busy else 0.0
+        )
+        bw_pct = (
+            min(100.0, 100.0 * sustained_gbps / self.peak_hbm_gbps)
+            if self.peak_hbm_gbps
+            else None
+        )
         return DecodeStats(
             steps=self._steps,
             tokens_generated=tokens,
             tokens_per_sec=tokens / self._busy if self._busy else 0.0,
             cache_bytes=cache_bytes,
             seconds=self._busy,
+            achieved_gbps=achieved_gbps,
+            hbm_bw_util_pct=bw_pct,
+            utilization_pct=min(100.0, 100.0 * win_busy / wall),
         )
 
 
 def main() -> None:
     """``WORKLOAD=decode python -m k8s_gpu_hpa_tpu.loadgen`` — the serving
-    container shape.  Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_LAYERS, plus the
-    standard intensity knob (TPU_TEST_INTENSITY / the watched file)."""
+    container shape: offered-load generator → request queue → decode worker.
+
+    Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_LAYERS, OFFERED_RPS_MAX (offered
+    load at knob=1.0; default 4× one worker's measured capacity so cranking
+    the knob genuinely outruns one pod and drives the External rung), plus
+    the standard intensity knob (TPU_TEST_INTENSITY / the watched file) now
+    meaning "fraction of OFFERED_RPS_MAX offered".
+    """
     import os
 
     from k8s_gpu_hpa_tpu.loadgen.knob import IntensityKnob
+    from k8s_gpu_hpa_tpu.loadgen.telemetry import TelemetryWriter
 
     gen = DecodeLoadGen(
         batch=int(os.environ.get("DECODE_BATCH", "8")),
@@ -134,24 +243,55 @@ def main() -> None:
     )
     gen.warmup()
     knob = IntensityKnob()
+    telemetry = TelemetryWriter()
+    queue = RequestQueue()
+    # calibrate one worker's request throughput (requests = whole sequences'
+    # bursts: batch requests per burst) so the default offered ceiling
+    # meaningfully exceeds capacity
+    t0 = time.perf_counter()
+    gen.step()
+    burst_seconds = max(time.perf_counter() - t0, 1e-6)
+    capacity_rps = gen.batch / burst_seconds
+    offered_rps_max = float(
+        os.environ.get("OFFERED_RPS_MAX", str(4.0 * capacity_rps))
+    )
     report_every = float(os.environ.get("REPORT_S", "10"))
     print(
         f"tpu-test decode loadgen: batch={gen.batch} ctx={gen.cfg.max_seq} "
         f"cache={gen.stats().cache_bytes / 1e6:.0f}MB on "
-        f"{jax.devices()[0].device_kind} (knob: {knob.file})",
+        f"{jax.devices()[0].device_kind} capacity~{capacity_rps:.1f}rps "
+        f"offered_max={offered_rps_max:.1f}rps (knob: {knob.file}"
+        + (f", telemetry: {telemetry.path}" if telemetry.enabled else "")
+        + ")",
         flush=True,
     )
     last_report = time.perf_counter()
+    last_tick = time.perf_counter()
     while True:
-        if knob.poll() <= 0.0:
-            knob.throttle(0.0)
+        now = time.perf_counter()
+        queue.offer((now - last_tick) * knob.poll() * offered_rps_max)
+        last_tick = now
+        if queue.depth >= 1.0:
+            gen.step()
+            queue.take(gen.batch)
         else:
-            knob.throttle(gen.step())
+            time.sleep(0.05)  # idle: wait for demand, don't spin
+        s = gen.stats()
+        telemetry.write(
+            duty_cycle_pct=s.utilization_pct,
+            hbm_bw_util_pct=s.hbm_bw_util_pct,
+            queue_depth=queue.depth,
+        )
         if time.perf_counter() - last_report >= report_every:
-            s = gen.stats()
             print(
                 f"bursts={s.steps} tok/s={s.tokens_per_sec:.0f} "
-                f"busy={s.seconds:.1f}s",
+                f"busy={s.seconds:.1f}s queue={queue.depth:.0f} "
+                f"bw={s.achieved_gbps:.0f}GB/s"
+                + (
+                    f" ({s.hbm_bw_util_pct:.1f}% of peak)"
+                    if s.hbm_bw_util_pct is not None
+                    else ""
+                ),
                 flush=True,
             )
             last_report = time.perf_counter()

@@ -41,21 +41,39 @@ PEAK_BF16_TFLOPS = {
     "TPU v6 lite": 918.0,  # v6e / Trillium
 }
 
+#: peak HBM bandwidth GB/s per chip by device kind (public Cloud TPU specs);
+#: denominator for workload self-reported bandwidth utilization (decode rung).
+PEAK_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,  # v5e
+    "TPU v5": 2765.0,  # v5p
+    "TPU v6 lite": 1640.0,  # v6e / Trillium
+}
 
-def peak_tflops_for(device) -> float | None:
+
+def _peak_for(device, table: dict[str, float]) -> float | None:
     kind = getattr(device, "device_kind", "")
     # longest-prefix match so "TPU v5 lite" wins over "TPU v5"
     best = None
-    for name, tflops in PEAK_BF16_TFLOPS.items():
+    for name, value in table.items():
         if kind.startswith(name) and (best is None or len(name) > best[0]):
-            best = (len(name), tflops)
+            best = (len(name), value)
     return best[1] if best else None
+
+
+def peak_tflops_for(device) -> float | None:
+    return _peak_for(device, PEAK_BF16_TFLOPS)
+
+
+def peak_hbm_gbps_for(device) -> float | None:
+    return _peak_for(device, PEAK_HBM_GBPS)
 
 
 @dataclass
 class LoadGenStats:
     utilization: float  # achieved duty-cycle percent over the last window
-    achieved_tflops: float  # sustained over busy time
+    achieved_tflops: float  # compute rate over busy time (kernel efficiency)
+    sustained_tflops: float  # compute rate over WALL time (includes idle)
     steps: int
     busy_seconds: float
     wall_seconds: float
@@ -234,7 +252,7 @@ class MatmulLoadGen:
 
     def stats(self) -> LoadGenStats:
         if not self._history:
-            return LoadGenStats(0.0, 0.0, self._steps, 0.0, 0.0)
+            return LoadGenStats(0.0, 0.0, 0.0, self._steps, 0.0, 0.0)
         busy = sum(b for _, b, _ in self._history)
         flops = sum(f for _, _, f in self._history)
         t_first = self._history[0][0]
@@ -247,6 +265,7 @@ class MatmulLoadGen:
         return LoadGenStats(
             utilization=min(100.0, 100.0 * busy / wall),
             achieved_tflops=(flops / compute / 1e12) if flops > 0 else 0.0,
+            sustained_tflops=flops / wall / 1e12,
             steps=self._steps,
             busy_seconds=busy,
             wall_seconds=wall,
@@ -257,10 +276,16 @@ class MatmulLoadGen:
         return self.stats().utilization
 
     def mxu_utilization(self) -> float | None:
-        """Achieved/peak FLOPs percent, when the chip's peak is known."""
+        """MXU utilization percent: FLOPs over WALL time divided by peak.
+
+        Time-averaged by definition — a 20 % duty cycle at full kernel
+        efficiency reads ~19 %, and a memory-bound workload reads near 0 even
+        while 100 % busy.  (Dividing the *busy-time* rate by peak would pin
+        this near 96 regardless of load — the round-1 shape of the metric
+        confusion VERDICT.md #2 calls out.)"""
         if self.peak_tflops is None:
             return None
-        return min(100.0, 100.0 * self.stats().achieved_tflops / self.peak_tflops)
+        return min(100.0, 100.0 * self.stats().sustained_tflops / self.peak_tflops)
 
 
 def main() -> None:
@@ -269,21 +294,34 @@ def main() -> None:
     Env: MATMUL_SIZE, TPU_TEST_INTENSITY (initial duty cycle),
     TPU_TEST_INTENSITY_FILE (runtime knob), REPORT_S (stats print period).
     """
+    from k8s_gpu_hpa_tpu.loadgen.telemetry import TelemetryWriter
+
     size = int(os.environ.get("MATMUL_SIZE", "4096"))
     report_every = float(os.environ.get("REPORT_S", "10"))
     gen = MatmulLoadGen(size=size)
     gen.warmup()
+    telemetry = TelemetryWriter()
     print(
         f"tpu-test loadgen: {size}x{size} bf16 matmul bursts on "
         f"{gen.device.device_kind}, intensity={gen.intensity} "
-        f"(knob: {gen.intensity_file})",
+        f"(knob: {gen.intensity_file}"
+        + (f", telemetry: {telemetry.path}" if telemetry.enabled else "")
+        + ")",
         flush=True,
     )
     last_report = time.perf_counter()
     while True:
         gen.step()
+        s = gen.stats()
+        # self-report the gauges only the workload can measure: duty cycle
+        # (busy fraction) and the genuine MXU rate — distinct numbers with
+        # distinct meanings (metrics/schema.py's table)
+        telemetry.write(
+            tensorcore_util_pct=gen.mxu_utilization(),
+            duty_cycle_pct=s.utilization,
+            achieved_tflops=s.achieved_tflops,
+        )
         if time.perf_counter() - last_report >= report_every:
-            s = gen.stats()
             mxu = gen.mxu_utilization()
             print(
                 f"util={s.utilization:.1f}% achieved={s.achieved_tflops:.1f}TFLOP/s"

@@ -148,11 +148,11 @@ class Avg(Expr):
 
 @dataclass
 class Aggregate(Expr):
-    """``min(child)`` / ``max(child)`` / ``sum(child)`` — whole-vector scalar
-    aggregation (``avg`` keeps its dedicated node for rendering parity with
-    the shipped rules)."""
+    """``min(child)`` / ``max(child)`` / ``sum(child)`` / ``count(child)`` —
+    whole-vector scalar aggregation (``avg`` keeps its dedicated node for
+    rendering parity with the shipped rules)."""
 
-    op: str  # "min" | "max" | "sum"
+    op: str  # "min" | "max" | "sum" | "count"
     child: Expr
 
     def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
@@ -160,11 +160,29 @@ class Aggregate(Expr):
         if not vec:
             return []
         values = [s.value for s in vec]
-        fn = {"min": min, "max": max, "sum": sum}[self.op]
-        return [Sample(fn(values), ())]
+        fn = {"min": min, "max": max, "sum": sum, "count": len}[self.op]
+        return [Sample(float(fn(values)), ())]
 
     def promql(self) -> str:
         return f"{self.op}({self.child.promql()})"
+
+
+@dataclass
+class AndOn(Expr):
+    """``left and on() right`` — PromQL set intersection with an empty match
+    group: left's samples survive iff right is non-empty.  The gate idiom —
+    "condition A, but only while condition B holds somewhere"."""
+
+    left: Expr
+    right: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        if not self.right.evaluate(db, at):
+            return []
+        return self.left.evaluate(db, at)
+
+    def promql(self) -> str:
+        return f"{self.left.promql()} and on() {self.right.promql()}"
 
 
 @dataclass
@@ -327,13 +345,19 @@ def tpu_test_avg_rule(
 
 def pipeline_alert_rules(
     record: str = "tpu_test_tensorcore_avg",
+    app: str = "tpu-test",
 ) -> list[AlertRule]:
     """The pipeline's own health alerts — the joints' silent-breakage modes
     (SURVEY.md §1) made loud.  The reference ships no alerting at all; these
-    cover the three ways the loop dies without an error surfacing anywhere:
-    an exporter stops being up, an exporter freezes (stale samples), or the
-    recorded autoscale series vanishes (any upstream joint broken)."""
+    cover the four ways the loop dies without an error surfacing anywhere:
+    an exporter stops being up, an exporter freezes (stale samples), the
+    recorded autoscale series vanishes (any upstream joint broken), or the
+    series exists but is pinned at zero while the workload runs — the
+    "present but dead" mode VERDICT.md weak #3 identified: a source
+    exporting fake zeros (or a workload whose self-report channel broke)
+    keeps the HPA permanently becalmed and Absent never fires."""
     return [
+        flat_zero_alert(record, app),
         AlertRule(
             alert="TpuExporterDown",
             expr=Cmp(Aggregate("min", Select("tpu_metrics_exporter_up")), "<", 1),
@@ -371,6 +395,44 @@ def pipeline_alert_rules(
                 "is broken - the HPA is flying blind (holding)"
             },
         ),
+    ]
+
+
+def flat_zero_alert(record: str, app: str) -> AlertRule:
+    """``record == 0 and on() count(kube_pod_labels{label_app=app}) > 0`` —
+    the autoscale series is present but pinned at zero while the workload has
+    pods.  Catches what Absent cannot: a source feeding fake zeros (round 1's
+    bw degradation) or a broken self-report channel.  Two minutes of ``for:``
+    tolerates genuinely idle-but-alive workloads briefly at 0."""
+    return AlertRule(
+        alert="TpuAutoscaleSignalFlatZero",
+        expr=AndOn(
+            Cmp(Select(record), "==", 0),
+            Cmp(
+                Aggregate("count", Select("kube_pod_labels", {"label_app": app})),
+                ">",
+                0,
+            ),
+        ),
+        for_seconds=120.0,
+        labels={"severity": "warning", "record": record},
+        annotations={
+            "summary": f"autoscale series {record} is present but flat zero "
+            f"while {app} pods are running: the device counter or workload "
+            "self-report feeding it is broken, and the HPA will never scale "
+            "this rung"
+        },
+    )
+
+
+def shipped_alert_rules() -> list[AlertRule]:
+    """THE shipped alert list — single source for manifests.py, the YAML
+    generator (tools/gen_prometheusrule.py), and the parity test.  The serve
+    rung's bw signal gets its own flat-zero guard: it is the series most
+    likely to go present-but-dead (bw fallback chain, VERDICT.md weak #3),
+    and its flatline must page even while the tensorcore rung is healthy."""
+    return pipeline_alert_rules() + [
+        flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve")
     ]
 
 

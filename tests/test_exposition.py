@@ -44,7 +44,12 @@ def test_roundtrip_preserves_values_and_labels():
         [make_chip(0, 42.5), make_chip(1, 99.0)], node="n1", attribution=attribution
     )
     parsed = {f.name: f for f in parse_text(encode_text(fams))}
-    assert set(parsed) == set(CHIP_METRICS)
+    # make_chip measures the five classic gauges; temp/power are None →
+    # absent families (never exported as fake values)
+    assert set(parsed) == set(CHIP_METRICS) - {
+        "tpu_chip_temperature_celsius",
+        "tpu_chip_power_watts",
+    }
     util = parsed[TPU_TENSORCORE_UTIL]
     by_chip = {s.label("chip"): s for s in util.samples}
     assert by_chip["0"].value == 42.5

@@ -443,7 +443,18 @@ def test_shipped_external_hpa_scales_on_queue_depth():
     def publish(depth):
         db.append(
             series,
-            (("namespace", "default"), ("queue", "tpu-test")),
+            # label set the decode fleet's self-report produces (selector
+            # from the manifest, so this test can't drift from it)
+            tuple(
+                sorted(
+                    {
+                        "namespace": "default",
+                        **hpa_doc["spec"]["metrics"][0]["external"]["metric"][
+                            "selector"
+                        ]["matchLabels"],
+                    }.items()
+                )
+            ),
             depth,
             clock.now(),
         )

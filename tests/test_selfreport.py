@@ -1,0 +1,317 @@
+"""Workload self-telemetry: writer → reader → daemon merge → /metrics → HPA.
+
+The channel that fixes VERDICT.md weak #1-#4: ``tpu_tensorcore_utilization``
+becomes a genuine workload-reported MXU rate (never a duty-cycle alias),
+``tpu_hbm_memory_bandwidth_utilization`` gets a measured fallback on libtpu
+builds without the counter, and ``tpu_test_queue_depth`` gets a real producer.
+"""
+
+import time
+import urllib.request
+
+from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+from k8s_gpu_hpa_tpu.exporter.native import build_native
+from k8s_gpu_hpa_tpu.exporter.selfreport import SelfReportReader, merge_reports
+from k8s_gpu_hpa_tpu.exporter.sources import LibtpuSource
+from k8s_gpu_hpa_tpu.exporter.stub_libtpu import StubLibtpuServer
+from k8s_gpu_hpa_tpu.loadgen.telemetry import TelemetryWriter
+from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    ChipSample,
+    TPU_DUTY_CYCLE,
+    TPU_HBM_BW_UTIL,
+    TPU_TENSORCORE_UTIL,
+)
+
+NO_BW = [
+    "tpu.runtime.tensorcore.dutycycle.percent",
+    "tpu.runtime.hbm.memory.usage.bytes",
+    "tpu.runtime.hbm.memory.total.bytes",
+]
+
+
+def libtpu_chip(i=0, duty=50.0):
+    """The shape LibtpuSource produces on a bw-less build: tensorcore and bw
+    ABSENT (None), duty from the device counter."""
+    return ChipSample(
+        accel_index=i,
+        tensorcore_util=None,
+        duty_cycle=duty,
+        hbm_usage_bytes=8e9,
+        hbm_total_bytes=16e9,
+        hbm_bw_util=None,
+    )
+
+
+# ---- writer → reader ------------------------------------------------------
+
+
+def test_writer_reader_roundtrip(tmp_path):
+    writer = TelemetryWriter(
+        directory=str(tmp_path), pod="tpu-test-abc", namespace="default"
+    )
+    assert writer.write(
+        tensorcore_util_pct=42.5, duty_cycle_pct=88.0, achieved_tflops=83.7
+    )
+    reports = SelfReportReader(str(tmp_path)).read()
+    report = reports[("default", "tpu-test-abc")]
+    assert report.tensorcore_util_pct == 42.5
+    assert report.duty_cycle_pct == 88.0
+    assert report.achieved_tflops == 83.7
+    assert report.hbm_bw_util_pct is None
+
+
+def test_reader_drops_stale_and_torn_files(tmp_path):
+    writer = TelemetryWriter(
+        directory=str(tmp_path), pod="fresh-pod", namespace="default"
+    )
+    writer.write(tensorcore_util_pct=10.0)
+    (tmp_path / "torn-pod.json").write_text('{"pod": "torn-pod", "ts": ')
+    (tmp_path / "not-json.txt").write_text("ignore me")
+    # a stale report: valid JSON, ancient timestamp
+    stale = TelemetryWriter(
+        directory=str(tmp_path), pod="dead-pod", namespace="default"
+    )
+    stale.write(tensorcore_util_pct=99.0)
+    reader = SelfReportReader(
+        str(tmp_path), staleness_s=30.0, now_fn=lambda: time.time() + 120.0
+    )
+    assert reader.read() == {}  # everything aged out or unreadable
+    reader_now = SelfReportReader(str(tmp_path), staleness_s=30.0)
+    assert set(reader_now.read()) == {("default", "fresh-pod"), ("default", "dead-pod")}
+
+
+def test_writer_rate_limits_and_clears(tmp_path):
+    writer = TelemetryWriter(
+        directory=str(tmp_path), pod="p", namespace="d", min_interval=3600.0
+    )
+    assert writer.write(duty_cycle_pct=1.0)
+    assert not writer.write(duty_cycle_pct=2.0)  # inside min_interval
+    assert writer.write(duty_cycle_pct=3.0, force=True)
+    writer.clear()
+    assert SelfReportReader(str(tmp_path)).read() == {}
+
+
+# ---- merge semantics ------------------------------------------------------
+
+
+def _report(ns="default", pod="tpu-test-abc", **kw):
+    from k8s_gpu_hpa_tpu.exporter.selfreport import SelfReport
+
+    return SelfReport(namespace=ns, pod=pod, ts=time.time(), **kw)
+
+
+def test_merge_fills_only_absent_gauges():
+    chips = [libtpu_chip(0), libtpu_chip(1, duty=80.0)]
+    attribution = {0: ("default", "tpu-test-abc")}  # chip 1 unattributed
+    reports = {
+        ("default", "tpu-test-abc"): _report(
+            tensorcore_util_pct=37.0, hbm_bw_util_pct=61.0, duty_cycle_pct=99.0
+        )
+    }
+    merged = merge_reports(chips, attribution, reports)
+    assert merged[0].tensorcore_util == 37.0  # filled: device had none
+    assert merged[0].hbm_bw_util == 61.0  # filled: bw-less libtpu
+    assert merged[0].duty_cycle == 50.0  # device counter WINS over report
+    # unattributed chip: a report can never paint chips it doesn't own
+    assert merged[1].tensorcore_util is None
+    assert merged[1].hbm_bw_util is None
+
+
+def test_queue_gauge_requires_kubelet_attribution(tmp_path):
+    """The trust gate: a report claiming an identity the kubelet doesn't
+    place on this node exports NOTHING — chip gauges or queue depth — so a
+    rogue pod can't drive the External HPA with a fabricated queue."""
+    build_native()
+    rogue = TelemetryWriter(
+        directory=str(tmp_path), pod="evil-pod", namespace="default"
+    )
+    rogue.write(queue_depth=1e6, tensorcore_util_pct=99.0, force=True)
+    legit = TelemetryWriter(
+        directory=str(tmp_path), pod="tpu-serve-abc", namespace="default"
+    )
+    legit.write(queue_depth=50.0, force=True)
+    with StubLibtpuServer(num_chips=1, supported_metrics=NO_BW) as server:
+        source = LibtpuSource(address=server.address)
+        with ExporterDaemon(
+            source,
+            attributor=FakeAttributor({0: ("default", "tpu-serve-abc")}),
+            selfreport=SelfReportReader(str(tmp_path)),
+            node_name="n0",
+            listen_addr="127.0.0.1",
+            port=0,
+        ) as daemon:
+            daemon.step()
+            body = _fetch(daemon.port)
+        source.close()
+    fams = {f.name: f for f in parse_text(body)}
+    q = {s.label("pod"): s.value for s in fams["tpu_test_queue_depth"].samples}
+    assert q == {"tpu-serve-abc": 50.0}  # rogue report gated out entirely
+    assert TPU_TENSORCORE_UTIL not in fams  # rogue's 99% painted nothing
+
+
+def test_merge_device_bw_counter_wins():
+    chip = ChipSample(0, None, 50.0, 8e9, 16e9, hbm_bw_util=33.0)
+    reports = {("default", "p"): _report(pod="p", hbm_bw_util_pct=90.0)}
+    merged = merge_reports([chip], {0: ("default", "p")}, reports)
+    assert merged[0].hbm_bw_util == 33.0
+
+
+# ---- end-to-end through the daemon + native core --------------------------
+
+
+class FakeAttributor:
+    def __init__(self, mapping):
+        self.mapping = mapping
+
+    def list_allocations(self):
+        return self.mapping
+
+
+def _fetch(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as r:
+        return r.read().decode()
+
+
+def test_memory_bound_divergence_end_to_end(tmp_path):
+    """VERDICT.md #2's done-criterion: under a memory-bound workload the two
+    activity series DIVERGE — duty cycle (device counter, busy ≈ always) high,
+    tensorcore utilization (workload MXU rate) low — all the way through the
+    production path: libtpu gRPC + telemetry file → daemon merge → C++ render.
+    Also proves the bw fallback (VERDICT.md #3): libtpu has no bw metric
+    (_bw_supported False) yet the serve signal exists, from the workload."""
+    build_native()
+    # the workload: memory-bound decode — busy 96% of the time, MXU ~7%
+    writer = TelemetryWriter(
+        directory=str(tmp_path), pod="tpu-serve-abc", namespace="default"
+    )
+    writer.write(
+        tensorcore_util_pct=7.0,
+        hbm_bw_util_pct=62.0,
+        queue_depth=240.0,
+        force=True,
+    )
+    with StubLibtpuServer(num_chips=2, supported_metrics=NO_BW) as server:
+        source = LibtpuSource(address=server.address)
+        with ExporterDaemon(
+            source,
+            attributor=FakeAttributor({0: ("default", "tpu-serve-abc")}),
+            selfreport=SelfReportReader(str(tmp_path)),
+            node_name="n0",
+            listen_addr="127.0.0.1",
+            port=0,
+        ) as daemon:
+            daemon.step()
+            body = _fetch(daemon.port)
+        assert source._bw_supported is False
+        source.close()
+    fams = {f.name: f for f in parse_text(body)}
+
+    by_chip = lambda fam: {s.label("chip"): s.value for s in fams[fam].samples}
+    duty = by_chip(TPU_DUTY_CYCLE)
+    assert duty == {"0": 50.0, "1": 50.0}  # device counter, both chips
+    # tensorcore: ONLY the attributed chip, from the workload, diverging
+    tc = by_chip(TPU_TENSORCORE_UTIL)
+    assert tc == {"0": 7.0}
+    assert tc["0"] != duty["0"]
+    # bw fallback: present despite _bw_supported=False, measured not zero
+    bw = by_chip(TPU_HBM_BW_UTIL)
+    assert bw == {"0": 62.0}
+    # queue depth: the External rung's producer exists now
+    q = fams["tpu_test_queue_depth"].samples
+    assert len(q) == 1
+    assert q[0].value == 240.0
+    assert q[0].label("queue") == "tpu-test"
+    assert q[0].label("pod") == "tpu-serve-abc"
+
+
+def test_serve_rung_closed_loop_on_selfreported_bw(tmp_path):
+    """VERDICT.md #3's done-criterion: tpu-serve scales out on a MEASURED bw
+    signal while libtpu serves no bw counter.  Full production joints: stub
+    libtpu (no bw) + telemetry → daemon → /metrics scrape → serve recording
+    rule → adapter → the SHIPPED tpu-serve-hpa.yaml parsed into the
+    controller."""
+    import pathlib
+
+    import yaml
+
+    from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter
+    from k8s_gpu_hpa_tpu.control.hpa import (
+        HPAController,
+        behavior_from_manifest,
+        metrics_from_manifest,
+    )
+    from k8s_gpu_hpa_tpu.metrics.rules import RuleEvaluator, tpu_test_avg_rule
+    from k8s_gpu_hpa_tpu.metrics.schema import TPU_HBM_BW_UTIL as BW
+    from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    build_native()
+    hpa_doc = yaml.safe_load(
+        (pathlib.Path(__file__).parent.parent / "deploy/tpu-serve-hpa.yaml").read_text()
+    )
+    record = hpa_doc["spec"]["metrics"][0]["object"]["metric"]["name"]
+    assert record == "tpu_serve_hbm_bw_avg"
+
+    writer = TelemetryWriter(
+        directory=str(tmp_path), pod="tpu-serve-abc", namespace="default"
+    )
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    rule = tpu_test_avg_rule(
+        app="tpu-serve", deployment="tpu-serve", metric=BW, record=record
+    )
+    evaluator = RuleEvaluator(db, [rule])
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series=record)])
+
+    class Target:
+        replicas = 1
+
+        def scale_to(self, n):
+            self.replicas = n
+
+    target = Target()
+    hpa = HPAController(
+        target=target,
+        metrics=metrics_from_manifest(hpa_doc),
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+
+    with StubLibtpuServer(num_chips=1, supported_metrics=NO_BW) as server:
+        source = LibtpuSource(address=server.address)
+        with ExporterDaemon(
+            source,
+            attributor=FakeAttributor({0: ("default", "tpu-serve-abc")}),
+            selfreport=SelfReportReader(str(tmp_path)),
+            node_name="n0",
+            listen_addr="127.0.0.1",
+            port=0,
+        ) as daemon:
+            scraper = Scraper(db)
+            scraper.add_target(lambda: _fetch(daemon.port), name="n0")
+            # saturated decode fleet: measured bw 85% of peak, target is 60
+            for _ in range(40):
+                writer.write(hbm_bw_util_pct=85.0, force=True)
+                daemon.step()
+                scraper.scrape_once()
+                db.append(
+                    "kube_pod_labels",
+                    (("label_app", "tpu-serve"), ("pod", "tpu-serve-abc")),
+                    1.0,
+                )
+                evaluator.evaluate_once()
+                if clock.now() % 15 < 1:
+                    hpa.sync_once()
+                clock.advance(1.0)
+        assert source._bw_supported is False
+        source.close()
+
+    assert db.latest(record, {"deployment": "tpu-serve"}) == 85.0
+    # ceil(1 * 85/60) = 2 — the rung scales on a signal round 1 pinned to 0
+    assert target.replicas >= 2, (target.replicas, hpa.status)

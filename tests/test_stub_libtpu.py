@@ -52,7 +52,10 @@ def test_source_reads_stub_over_grpc():
         chips = source.sample()
         source.close()
     assert [c.accel_index for c in chips] == [0, 1]
-    assert chips[0].tensorcore_util == 30.0
+    assert chips[0].duty_cycle == 30.0
+    # libtpu serves no MXU-rate counter: tensorcore_util is ABSENT on this
+    # source (the workload self-report supplies it), never a duty-cycle alias
+    assert chips[0].tensorcore_util is None
     assert chips[1].duty_cycle == 90.0
     assert chips[0].hbm_usage_bytes == 8e9
     # one GetRuntimeMetric per metric per sweep (bandwidth probed too on the
@@ -140,7 +143,8 @@ def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
         try:
             chips = source.sample()
             assert len(chips) == 2
-            assert all(c.hbm_bw_util == 0.0 for c in chips)
+            # unsupported bw → absent (None), not the round-1 silent flat 0
+            assert all(c.hbm_bw_util is None for c in chips)
             assert all(c.duty_cycle == 50.0 for c in chips)
             assert source._bw_supported is False
             source.sample()
@@ -162,7 +166,7 @@ def test_bandwidth_gated_off_by_supported_metrics_list():
         try:
             chips = source.sample()
             assert source._bw_supported is False
-            assert all(c.hbm_bw_util == 0.0 for c in chips)
+            assert all(c.hbm_bw_util is None for c in chips)
             source.sample()
             assert server.request_log.count(LIBTPU_HBM_BW) == 0  # never asked
         finally:

@@ -16,7 +16,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from k8s_gpu_hpa_tpu.metrics.rules import (
-    pipeline_alert_rules,
+    shipped_alert_rules,
     tpu_test_avg_rule,
     tpu_test_multihost_avg_rule,
     tpu_test_pod_max_rule,
@@ -148,7 +148,7 @@ def render() -> str:
         "      interval: 1s\n"
         "      rules:\n"
     )
-    for alert in pipeline_alert_rules():
+    for alert in shipped_alert_rules():
         out.append(f"        - alert: {alert.alert}\n")
         out.append(f"          expr: {alert.expr.promql()}\n")
         if alert.for_seconds:
