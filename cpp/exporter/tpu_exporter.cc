@@ -117,6 +117,7 @@ struct TpuExporter {
   std::vector<TpuChipSample> samples;               // guarded by mu
   std::map<int32_t, std::pair<std::string, std::string>> attribution;  // mu
   std::vector<QueueGauge> queue_gauges;             // guarded by mu
+  uint64_t enabled_mask = ~0ull;                    // guarded by mu; bit per family
   int64_t last_push_ms = -1;                        // guarded by mu
   uint64_t push_count = 0;                          // guarded by mu
 
@@ -161,6 +162,7 @@ struct TpuExporter {
     if (!fresh) return out;  // withhold stale chip gauges entirely
 
     for (int m = 0; m < kNumChipMetrics; ++m) {
+      if (!(enabled_mask & (1ull << m))) continue;  // field-list filter
       // NaN samples are "unmeasurable here" — omitted; a family where every
       // chip is NaN renders nothing at all (absent series, not HELP-only).
       bool any = false;
@@ -361,6 +363,23 @@ void tpu_exporter_replace_attribution(TpuExporter* ex, const int32_t* indices,
   }
   std::lock_guard<std::mutex> lock(ex->mu);
   ex->attribution.swap(next);
+}
+
+void tpu_exporter_set_enabled_metrics(TpuExporter* ex,
+                                      const char* const* names, int32_t n) {
+  uint64_t mask = 0;
+  if (n <= 0) {
+    mask = ~0ull;  // empty list = default: all families
+  } else {
+    for (int32_t i = 0; i < n; ++i) {
+      if (!names[i]) continue;
+      for (int m = 0; m < kNumChipMetrics; ++m) {
+        if (strcmp(names[i], kChipMetrics[m].name) == 0) mask |= 1ull << m;
+      }
+    }
+  }
+  std::lock_guard<std::mutex> lock(ex->mu);
+  ex->enabled_mask = mask;
 }
 
 void tpu_exporter_replace_queue_gauges(TpuExporter* ex,

@@ -75,6 +75,13 @@ void tpu_exporter_replace_attribution(TpuExporter* ex, const int32_t* indices,
                                       const char* const* namespaces,
                                       const char* const* pods, int32_t n);
 
+// Restrict which chip-metric families render (the analog of dcgm-exporter's
+// `-f <metrics.csv>` field list, dcgm-exporter.yaml:37).  `names` are family
+// names from the schema (e.g. "tpu_duty_cycle"); unknown names are ignored.
+// n == 0 restores the default: every family (subject to NaN omission).
+void tpu_exporter_set_enabled_metrics(TpuExporter* ex,
+                                      const char* const* names, int32_t n);
+
 // Atomically replace the per-pod serving-queue gauges (parallel arrays of
 // length n).  Rendered as the workload-level series
 //   tpu_test_queue_depth{namespace,node,pod,queue} <depth>

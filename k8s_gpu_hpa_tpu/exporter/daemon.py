@@ -33,6 +33,7 @@ class ExporterDaemon:
         attribution_interval: float = 10.0,
         clock: Clock | None = None,
         selfreport: SelfReportReader | None = None,
+        metric_fields: list[str] | None = None,
     ):
         self.source = source
         self.attributor = attributor
@@ -47,6 +48,19 @@ class ExporterDaemon:
             # up goes 0 after 3 missed collections, like dcgm watchdogs
             staleness_ms=int(collect_interval * 3000),
         )
+        if metric_fields:
+            # the dcgm `-f metrics.csv` analog: export only these families.
+            # Unknown names fail FAST — silently ignoring a typo would blank
+            # every family while the exporter still reports up=1.
+            from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
+
+            unknown = [f for f in metric_fields if f not in CHIP_METRICS]
+            if unknown:
+                raise ValueError(
+                    f"unknown metric fields {unknown}; valid families: "
+                    f"{sorted(CHIP_METRICS)}"
+                )
+            self.native.set_enabled_metrics(metric_fields)
         self._last_attribution = -float("inf")
         self._attribution: dict[int, tuple[str, str]] = {}
         self.sweeps = 0
@@ -147,13 +161,33 @@ def main() -> None:
 
         source = JaxDeviceSource()
         attributor = None
-    else:
+    # TPU_METRIC_FIELDS: comma-separated family names to export (the analog
+    # of dcgm-exporter's `-f <metrics.csv>`, dcgm-exporter.yaml:37); empty =
+    # every family the sources can measure.
+    fields = [
+        f.strip()
+        for f in os.environ.get("TPU_METRIC_FIELDS", "").split(",")
+        if f.strip()
+    ]
+
+    if source_kind not in ("stub", "jax"):
         from k8s_gpu_hpa_tpu.exporter.podresources import PodResourcesClient
         from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+        from k8s_gpu_hpa_tpu.metrics import schema
 
         # every runtime-metrics port on the node (TPU_RUNTIME_METRICS_PORTS,
-        # one per TPU workload process; defaults to the single 8431)
+        # one per TPU workload process; defaults to the single 8431).  The
+        # field filter also prunes acquisition: families the operator
+        # disabled cost no RPCs per sweep, like dcgm's watched-field list.
         source = MergedLibtpuSource.from_env()
+        if fields:
+            source.fetch_bw = schema.TPU_HBM_BW_UTIL in fields
+            source.fetch_temp_power = bool(
+                {schema.TPU_CHIP_TEMP, schema.TPU_CHIP_POWER} & set(fields)
+            )
+            for sub in source._sources:
+                sub.fetch_bw = source.fetch_bw
+                sub.fetch_temp_power = source.fetch_temp_power
         attributor = PodResourcesClient()
 
     # Workload self-telemetry (TPU_TELEMETRY_DIR hostPath, mounted by the
@@ -169,6 +203,7 @@ def main() -> None:
         port=int(os.environ.get("LISTEN_PORT", "9400")),
         collect_interval=float(os.environ.get("COLLECT_MS", "1000")) / 1000.0,
         selfreport=selfreport,
+        metric_fields=fields or None,
     )
     daemon.run_forever()
 

@@ -82,6 +82,11 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.c_int32,
         ]
+        lib.tpu_exporter_set_enabled_metrics.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int32,
+        ]
         lib.tpu_exporter_replace_queue_gauges.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_char_p),
@@ -152,6 +157,13 @@ class NativeExporter:
         self._lib.tpu_exporter_replace_attribution(
             self._handle, indices, namespaces, pods, n
         )
+
+    def set_enabled_metrics(self, names: list[str]) -> None:
+        """Restrict exposition to the named chip-metric families — the analog
+        of dcgm-exporter's ``-f <metrics.csv>`` field list (dcgm-exporter.yaml:37).
+        Empty list restores the default (all families)."""
+        arr = (ctypes.c_char_p * len(names))(*[n.encode() for n in names])
+        self._lib.tpu_exporter_set_enabled_metrics(self._handle, arr, len(names))
 
     def set_queue_gauges(
         self, gauges: list[tuple[str, str, str, float]]

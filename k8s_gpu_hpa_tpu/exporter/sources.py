@@ -179,6 +179,9 @@ class MergedLibtpuSource:
 
     addresses: list[str] = field(default_factory=lambda: ["localhost:8431"])
     timeout: float = 3.0
+    #: acquisition-side field filter, forwarded to every per-port source
+    fetch_bw: bool = True
+    fetch_temp_power: bool = True
     _sources: list["LibtpuSource"] = field(default=None, repr=False)
     #: lazy, recreated after close() (same lifecycle as LibtpuSource._channel)
     _pool: object = field(default=None, repr=False)
@@ -186,7 +189,12 @@ class MergedLibtpuSource:
     def __post_init__(self):
         if self._sources is None:
             self._sources = [
-                LibtpuSource(address=a, timeout=self.timeout)
+                LibtpuSource(
+                    address=a,
+                    timeout=self.timeout,
+                    fetch_bw=self.fetch_bw,
+                    fetch_temp_power=self.fetch_temp_power,
+                )
                 for a in self.addresses
             ]
 
@@ -265,6 +273,12 @@ class LibtpuSource:
 
     address: str = "localhost:8431"
     timeout: float = 3.0
+    #: acquisition-side field filter (the dcgm -f analog filters what is
+    #: COLLECTED, not just served): families disabled by TPU_METRIC_FIELDS
+    #: cost no RPCs.  The three core metrics are always fetched — they define
+    #: the device set.
+    fetch_bw: bool = True
+    fetch_temp_power: bool = True
     _channel: object = field(default=None, repr=False)
     #: None = untested; probed on the first sweep, sticky afterwards
     _bw_supported: bool | None = field(default=None, repr=False)
@@ -330,7 +344,11 @@ class LibtpuSource:
 
         if self._channel is None:
             self._channel = grpc.insecure_channel(self.address)
-        if self._bw_supported is None:
+        if not self.fetch_bw:
+            self._bw_supported = False
+        if self._bw_supported is None or (
+            self.fetch_temp_power and not self._supported_probed
+        ):
             # Capability-gate optional metrics on the advertised list when the
             # runtime has ListSupportedMetrics; older builds (RPC absent →
             # supported_metrics() is None) keep the probe-once fallback below.
@@ -338,14 +356,15 @@ class LibtpuSource:
             if advertised is not None:
                 if LIBTPU_HBM_BW not in advertised:
                     self._bw_supported = False
-                for name in libtpu_proto.CHIP_TEMP_CANDIDATES:
-                    if name in advertised:
-                        self._temp_name = name
-                        break
-                for name in libtpu_proto.CHIP_POWER_CANDIDATES:
-                    if name in advertised:
-                        self._power_name = name
-                        break
+                if self.fetch_temp_power:
+                    for name in libtpu_proto.CHIP_TEMP_CANDIDATES:
+                        if name in advertised:
+                            self._temp_name = name
+                            break
+                    for name in libtpu_proto.CHIP_POWER_CANDIDATES:
+                        if name in advertised:
+                            self._power_name = name
+                            break
         try:
             duty = self._get_metric(LIBTPU_DUTY_CYCLE)
             usage = self._get_metric(LIBTPU_HBM_USAGE)

@@ -63,6 +63,10 @@ class StubLibtpuServer:
             return 0.5 * self.hbm_total
         if name == sources.LIBTPU_HBM_TOTAL:
             return self.hbm_total
+        if name in libtpu_proto.CHIP_TEMP_CANDIDATES:
+            return 55.0
+        if name in libtpu_proto.CHIP_POWER_CANDIDATES:
+            return 120.0
         return 0.0
 
     def _handle(self, request: bytes, context) -> bytes:

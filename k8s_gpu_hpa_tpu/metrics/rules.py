@@ -425,6 +425,29 @@ def flat_zero_alert(record: str, app: str) -> AlertRule:
     )
 
 
+def chip_hot_alert(threshold_c: float = 90.0) -> AlertRule:
+    """Thermal guard on the raw per-chip series — the analog of the
+    reference's very first probe being ``dcgm_gpu_temp`` (README.md:46).
+    The family is capability-gated (exported only when libtpu advertises a
+    temperature metric), so on builds without it the expr is simply empty —
+    degradation is silence, never a false page."""
+    return AlertRule(
+        alert="TpuChipHot",
+        expr=Cmp(
+            Aggregate("max", Select("tpu_chip_temperature_celsius")),
+            ">",
+            threshold_c,
+        ),
+        for_seconds=60.0,
+        labels={"severity": "warning"},
+        annotations={
+            "summary": f"a TPU chip reports over {threshold_c:g}C for 60s: "
+            "sustained thermal pressure degrades clocks before it trips "
+            "hardware protection — check node cooling / duty cycles"
+        },
+    )
+
+
 def shipped_alert_rules() -> list[AlertRule]:
     """THE shipped alert list — single source for manifests.py, the YAML
     generator (tools/gen_prometheusrule.py), and the parity test.  The serve
@@ -432,7 +455,8 @@ def shipped_alert_rules() -> list[AlertRule]:
     likely to go present-but-dead (bw fallback chain, VERDICT.md weak #3),
     and its flatline must page even while the tensorcore rung is healthy."""
     return pipeline_alert_rules() + [
-        flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve")
+        flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve"),
+        chip_hot_alert(),
     ]
 
 

@@ -156,6 +156,40 @@ def test_flat_zero_alert_fires_only_while_pods_run():
     assert not alert.firing
 
 
+def test_chip_hot_alert_fires_on_sustained_heat_only():
+    """Thermal guard (the reference's dcgm_gpu_temp probe, README.md:46, made
+    an alert): fires after 60s over threshold; silent when the family is
+    absent (libtpu builds without a temperature metric)."""
+    from k8s_gpu_hpa_tpu.metrics.rules import chip_hot_alert
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    alert = chip_hot_alert(threshold_c=90.0)
+    evaluator = RuleEvaluator(db, [], alerts=[alert])
+
+    # family absent entirely (not advertised): never fires
+    for _ in range(120):
+        evaluator.evaluate_once()
+        clock.advance(1.0)
+    assert not alert.firing
+
+    # hot chip, sustained
+    for t in range(90):
+        db.append("tpu_chip_temperature_celsius", (("chip", "0"),), 95.0)
+        db.append("tpu_chip_temperature_celsius", (("chip", "1"),), 60.0)
+        evaluator.evaluate_once()
+        if t < 59:
+            assert not alert.firing
+        clock.advance(1.0)
+    assert alert.firing
+
+    # cooled: resets
+    db.append("tpu_chip_temperature_celsius", (("chip", "0"),), 70.0)
+    db.append("tpu_chip_temperature_celsius", (("chip", "1"),), 60.0)
+    evaluator.evaluate_once()
+    assert not alert.firing
+
+
 def test_shipped_alert_group_matches_asts():
     from pathlib import Path
 

@@ -187,6 +187,112 @@ def test_supported_metrics_rpc_absent_falls_back_to_probe():
             source.close()
 
 
+def test_temperature_power_served_when_advertised():
+    """Thermal/power telemetry (the reference's dcgm_gpu_temp probe,
+    README.md:46): fetched ONLY when libtpu advertises a matching name."""
+    from k8s_gpu_hpa_tpu.exporter import libtpu_proto
+
+    advertised = [
+        LIBTPU_DUTY_CYCLE,
+        LIBTPU_HBM_USAGE,
+        LIBTPU_HBM_TOTAL,
+        libtpu_proto.CHIP_TEMP_CANDIDATES[0],
+        libtpu_proto.CHIP_POWER_CANDIDATES[0],
+    ]
+    with StubLibtpuServer(num_chips=2, supported_metrics=advertised) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert [c.temperature_c for c in chips] == [55.0, 55.0]
+            assert [c.power_w for c in chips] == [120.0, 120.0]
+        finally:
+            source.close()
+
+
+def test_temperature_absent_when_not_advertised():
+    """No advertisement → no fetch attempt, family absent (graceful
+    degradation — candidate names are never blind-probed)."""
+    from k8s_gpu_hpa_tpu.exporter import libtpu_proto
+
+    with StubLibtpuServer(num_chips=1) as server:  # default: 4 classic names
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert chips[0].temperature_c is None
+            assert chips[0].power_w is None
+            for name in libtpu_proto.CHIP_TEMP_CANDIDATES:
+                assert server.request_log.count(name) == 0
+        finally:
+            source.close()
+
+
+def test_metric_field_filter_restricts_exposition():
+    """The dcgm `-f metrics.csv` analog (dcgm-exporter.yaml:37): the daemon's
+    TPU_METRIC_FIELDS knob restricts which families render."""
+    from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+
+    build_native()
+    with StubLibtpuServer(num_chips=2) as server:
+        source = LibtpuSource(address=server.address)
+        with ExporterDaemon(
+            source,
+            node_name="n0",
+            listen_addr="127.0.0.1",
+            port=0,
+            metric_fields=["tpu_duty_cycle", "tpu_hbm_memory_usage_bytes"],
+        ) as daemon:
+            daemon.step()
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.port}/metrics", timeout=5
+            ) as r:
+                body = r.read().decode()
+        source.close()
+    fams = {f.name for f in parse_text(body) if f.samples}
+    assert "tpu_duty_cycle" in fams
+    assert "tpu_hbm_memory_usage_bytes" in fams
+    assert "tpu_hbm_memory_total_bytes" not in fams  # filtered out
+
+
+def test_metric_field_filter_rejects_unknown_names():
+    """A typo'd field name must fail fast, not silently blank every family
+    while the exporter still reports up=1."""
+    import pytest as _pytest
+
+    from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+    from k8s_gpu_hpa_tpu.exporter.sources import StubSource
+
+    build_native()
+    with _pytest.raises(ValueError, match="tpu_duty_cyle"):
+        ExporterDaemon(
+            StubSource(num_chips=1),
+            listen_addr="127.0.0.1",
+            port=-1,
+            metric_fields=["tpu_duty_cyle"],  # note the typo
+        )
+
+
+def test_field_filter_prunes_acquisition_rpcs():
+    """Disabled families cost no RPCs (dcgm's watched-field semantics, not
+    just render-side hiding)."""
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
+    with StubLibtpuServer(num_chips=1) as server:
+        source = LibtpuSource(
+            address=server.address, fetch_bw=False, fetch_temp_power=False
+        )
+        try:
+            source.sample()
+            source.sample()
+            assert server.request_log.count(LIBTPU_HBM_BW) == 0
+            # with everything optional disabled, the capability list itself
+            # is never needed either
+            assert source._supported_probed is False
+        finally:
+            source.close()
+
+
 def test_merged_source_unions_per_process_servers():
     """A node with several TPU pods runs one runtime-metrics server per
     process; the merged source must see every pod's chips."""
