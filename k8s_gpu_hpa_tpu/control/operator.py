@@ -12,16 +12,35 @@ watches HPAs annotated ``k8s-tpu-hpa/replica-quantum: "<q>"``
 (deploy/tpu-test-multihost-hpa.yaml) and repairs the target's scale
 subresource whenever the HPA lands off a slice boundary:
 
-- scaling up (desired > current): round UP to the next whole slice — a
-  partial slice adds capacity only when completed;
-- scaling down / steady: round UP but never past the current count — hold
-  the extra hosts until the HPA itself removes a whole slice (mirrors
-  control/hpa.py's down-direction rule);
+- growing (desired > current): round UP to the next whole slice — a partial
+  slice adds capacity only when completed;
+- actively shrinking (desired < current): release down to the whole-slice
+  count — the HPA is moving the same direction, so the repair converges with
+  its next sync instead of fighting it;
+- steady (desired == current) off-boundary: HOLD.  The vanilla HPA re-asserts
+  its desired count on every sync, so any patch here starts an unbounded
+  patch war (operator releases 3→2, HPA re-asserts 3, forever) that churns
+  multi-host slice pods.  The stranded host is the lesser evil; the native
+  controller (control/hpa.py), which owns the count outright and has no
+  second writer to fight, releases it instead — that is the one deliberate
+  divergence between the two rules;
 - bounds snap inward to slice multiples, exactly as the controller does.
+
+Residual wars (e.g. ``minReplicas`` not a slice multiple, so the HPA's legal
+floor is below the effective slice floor) are bounded by a repair-suppression
+guard: if the operator's last patch for a target was reverted back to the
+exact same observed ``(current, hpa_desired)`` state, the repeat repair is
+suppressed until the state genuinely changes.
+
+Single-flight: the Deployment runs one replica, and a coordination.k8s.io
+Lease (held by pod name, renewed each reconcile interval) guards the
+rolling-update window where two replicas briefly coexist — only the lease
+holder patches.  A tiny HTTP server exposes ``/healthz`` (reconcile loop
+recently ticked) and ``/readyz`` (holding the lease) for the Deployment's
+probes (deploy/quantum-operator.yaml).
 
 Everything is stdlib REST against the API server (service-account token, no
 kubernetes client dependency) — the same pattern as exporter/kubeapi.py.
-Ships as a one-replica Deployment (deploy/quantum-operator.yaml).
 """
 
 from __future__ import annotations
@@ -30,9 +49,12 @@ import json
 import math
 import os
 import ssl
+import threading
 import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
 QUANTUM_ANNOTATION = "k8s-tpu-hpa/replica-quantum"
 TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
@@ -47,7 +69,7 @@ SCALE_PATHS = {
 
 
 class KubeClient:
-    """Minimal API-server client: GET + PATCH with the in-cluster token."""
+    """Minimal API-server client: GET + PATCH + POST with the in-cluster token."""
 
     def __init__(
         self,
@@ -83,7 +105,12 @@ class KubeClient:
         data = None
         if body is not None:
             data = json.dumps(body).encode()
-            req.add_header("Content-Type", "application/merge-patch+json")
+            content_type = (
+                "application/merge-patch+json"
+                if method == "PATCH"
+                else "application/json"
+            )
+            req.add_header("Content-Type", content_type)
         with urllib.request.urlopen(
             req, data=data, timeout=10, context=self._context()
         ) as r:
@@ -94,6 +121,13 @@ class KubeClient:
 
     def patch(self, path: str, body: dict) -> dict:
         return self._request("PATCH", path, body)
+
+    def post(self, path: str, body: dict) -> dict:
+        return self._request("POST", path, body)
+
+
+class _LeaseLost(Exception):
+    """Raised mid-reconcile when the leadership re-check fails."""
 
 
 @dataclass
@@ -112,27 +146,58 @@ def quantum_desired(
     min_replicas: int,
     max_replicas: int,
 ) -> int:
-    """The repair rule, shared verbatim with control/hpa.py's semantics:
-    growing rounds up to a whole slice, shrinking/steady rounds up but never
-    past ``current`` (hold the extra slice), bounds snap inward."""
+    """The operator's repair rule (module docstring has the full rationale):
+    growing rounds up to a whole slice; actively shrinking releases down to
+    the whole-slice count; steady off-boundary HOLDS (patching would start a
+    war with the vanilla HPA, which re-asserts its desired count every sync);
+    below the effective slice floor grows to it; bounds snap inward.
+
+    Matches control/hpa.py's quantum rounding except in the steady case,
+    where the native controller — sole owner of the count — releases the
+    partial slice instead (hpa.py's "repair partial slice" branch).
+    """
     q = quantum
     max_q = max_replicas // q * q
+    if max_q == 0:
+        # maxReplicas cannot fit even one whole slice — a misconfiguration
+        # (control/hpa.py rejects it with ValueError); never "repair" a live
+        # workload to 0 replicas over it
+        return current
     min_q = min(math.ceil(min_replicas / q) * q, max_q)
     if current % q == 0:
         return current  # on a boundary; nothing to repair
     if hpa_desired > current or current < min_q:
         return min(math.ceil(current / q) * q, max_q)
-    # shrinking or steady off-boundary: the partial slice's hosts serve
-    # nothing — release them down to the whole-slice count
+    if hpa_desired == current:
+        # steady off-boundary: hold — the HPA owns the count and would
+        # revert any release on its next sync (unbounded patch war)
+        return current
+    # actively shrinking: the partial slice's hosts serve nothing — release
+    # them down to the whole-slice count, converging with the HPA's direction
     return max(current // q * q, min_q)
 
 
 class QuantumOperator:
     """One reconcile loop over a namespace's annotated HPAs."""
 
-    def __init__(self, client: KubeClient, namespace: str = "default"):
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str = "default",
+        elector: "LeaseElector | None" = None,
+    ):
         self.client = client
         self.namespace = namespace
+        self.elector = elector
+        #: liveness signal: wall-clock of the last completed loop iteration
+        self.last_tick: float = time.monotonic()
+        #: target -> (current, hpa_desired, patched_to) of the last repair,
+        #: for the revert-war suppression guard
+        self._last_repair: dict[str, tuple[int, int, int]] = {}
+        #: targets whose suppression has been logged (log once per episode)
+        self._suppressed_logged: set[str] = set()
+        #: HPAs whose quantum>maxReplicas misconfig has been logged once
+        self._misconfig_logged: set[str] = set()
 
     def _list_hpas(self) -> list[dict]:
         path = (
@@ -144,77 +209,334 @@ class QuantumOperator:
     def reconcile_once(self) -> list[RepairAction]:
         actions: list[RepairAction] = []
         for hpa in self._list_hpas():
-            annotations = hpa["metadata"].get("annotations", {})
-            if QUANTUM_ANNOTATION not in annotations:
-                continue
-            q = int(annotations[QUANTUM_ANNOTATION])
-            if q <= 1:
-                continue
-            spec = hpa["spec"]
-            ref = spec["scaleTargetRef"]
-            if ref["kind"] not in SCALE_PATHS:
-                continue
-            group, plural = SCALE_PATHS[ref["kind"]]
-            scale_path = (
-                f"/apis/{group}/namespaces/{self.namespace}"
-                f"/{plural}/{ref['name']}/scale"
-            )
-            scale = self.client.get(scale_path)
-            current = int(scale.get("spec", {}).get("replicas") or 0)
-            if current == 0:
-                continue  # suspended/empty target: not the operator's call
-            status = hpa.get("status", {})
-            hpa_desired = int(status.get("desiredReplicas") or current)
-            desired = quantum_desired(
-                current,
-                hpa_desired,
-                q,
-                int(spec.get("minReplicas", 1)),
-                int(spec["maxReplicas"]),
-            )
-            if desired != current:
-                self.client.patch(scale_path, {"spec": {"replicas": desired}})
-                direction = "up" if desired > current else "down"
-                actions.append(
-                    RepairAction(
-                        hpa=hpa["metadata"]["name"],
-                        target=f"{ref['kind']}/{ref['name']}",
-                        from_replicas=current,
-                        to_replicas=desired,
-                        reason=(
-                            f"partial slice (quantum {q}): rounded {direction} "
-                            f"{current}->{desired}"
-                        ),
-                    )
+            try:
+                action = self._reconcile_hpa(hpa)
+            except _LeaseLost:
+                # a slow pass can outlive the lease: a standby may already
+                # be patching — abort the whole pass rather than split-brain
+                print("lost lease mid-reconcile; aborting pass", flush=True)
+                break
+            except Exception as e:
+                # one malformed HPA (typo'd annotation, deleted target) must
+                # not starve every other annotated HPA of repairs
+                name = hpa.get("metadata", {}).get("name", "?")
+                print(
+                    f"reconcile error for HPA {name}: {e} (continuing)",
+                    flush=True,
                 )
+                continue
+            if action is not None:
+                actions.append(action)
         return actions
+
+    def _reconcile_hpa(self, hpa: dict) -> RepairAction | None:
+        annotations = hpa["metadata"].get("annotations", {})
+        if QUANTUM_ANNOTATION not in annotations:
+            return None
+        q = int(annotations[QUANTUM_ANNOTATION])
+        if q <= 1:
+            return None
+        spec = hpa["spec"]
+        ref = spec["scaleTargetRef"]
+        if ref["kind"] not in SCALE_PATHS:
+            return None
+        name = hpa["metadata"]["name"]
+        max_replicas = int(spec["maxReplicas"])
+        if q > max_replicas:
+            # quantum_desired holds in this state; say why, once
+            if name not in self._misconfig_logged:
+                self._misconfig_logged.add(name)
+                print(
+                    f"HPA {name}: quantum {q} exceeds maxReplicas "
+                    f"{max_replicas} — cannot fit one whole slice; holding",
+                    flush=True,
+                )
+            return None
+        self._misconfig_logged.discard(name)
+        group, plural = SCALE_PATHS[ref["kind"]]
+        scale_path = (
+            f"/apis/{group}/namespaces/{self.namespace}"
+            f"/{plural}/{ref['name']}/scale"
+        )
+        scale = self.client.get(scale_path)
+        current = int(scale.get("spec", {}).get("replicas") or 0)
+        if current == 0:
+            return None  # suspended/empty target: not the operator's call
+        status = hpa.get("status", {})
+        hpa_desired = int(status.get("desiredReplicas") or current)
+        desired = quantum_desired(
+            current,
+            hpa_desired,
+            q,
+            int(spec.get("minReplicas", 1)),
+            max_replicas,
+        )
+        target = f"{ref['kind']}/{ref['name']}"
+        if desired == current:
+            last = self._last_repair.get(target)
+            if last is not None and current == last[2] and hpa_desired == last[1]:
+                # we are merely observing our OWN last patch holding (the
+                # operator ticks faster than the HPA syncs); the episode
+                # is not over — keep the memory so the HPA's upcoming
+                # revert stays suppressed instead of re-triggering a
+                # patch every sync period
+                return None
+            # genuinely acceptable state (or moved by someone else): the
+            # repair episode is over
+            self._last_repair.pop(target, None)
+            self._suppressed_logged.discard(target)
+            return None
+        last = self._last_repair.get(target)
+        if last is not None and last[:2] == (current, hpa_desired):
+            # we already repaired this exact observed state and something
+            # (the vanilla HPA) reverted it — repeating the patch would
+            # loop forever; suppress until the state genuinely changes
+            if target not in self._suppressed_logged:
+                self._suppressed_logged.add(target)
+                print(
+                    f"suppressing repeat repair of {target}: "
+                    f"({current}, hpa_desired={hpa_desired}) -> {last[2]} "
+                    "was reverted; another controller owns this count "
+                    "(check that minReplicas/maxReplicas are slice "
+                    "multiples)",
+                    flush=True,
+                )
+            return None
+        if self.elector is not None and not self.elector.still_leader():
+            # re-confirm leadership immediately before every write (each
+            # target costs up to two 10 s API timeouts)
+            raise _LeaseLost()
+        self.client.patch(scale_path, {"spec": {"replicas": desired}})
+        self._last_repair[target] = (current, hpa_desired, desired)
+        self._suppressed_logged.discard(target)
+        direction = "up" if desired > current else "down"
+        return RepairAction(
+            hpa=name,
+            target=target,
+            from_replicas=current,
+            to_replicas=desired,
+            reason=(
+                f"partial slice (quantum {q}): rounded {direction} "
+                f"{current}->{desired}"
+            ),
+        )
+
+    def tick(self) -> list[RepairAction]:
+        """One loop iteration: leader check (when electing), then reconcile."""
+        if self.elector is not None and not self.elector.ensure_leader():
+            return []
+        return self.reconcile_once()
 
     def run_forever(self, interval: float = 5.0) -> None:
         while True:
             try:
-                for action in self.reconcile_once():
+                for action in self.tick():
                     print(
                         f"repaired {action.target}: {action.reason}", flush=True
                     )
             except Exception as e:  # API blips: log and retry next tick
                 print(f"reconcile error: {e}", flush=True)
+            self.last_tick = time.monotonic()
             time.sleep(interval)
+
+
+class LeaseElector:
+    """coordination.k8s.io/v1 Lease leadership, stdlib REST only.
+
+    One replica normally runs (``strategy: Recreate``), so this guards the
+    windows where two operator pods can still coexist — a stuck-terminating
+    pod on a cordoned node, or a manually scaled-up Deployment: the patch
+    loop runs iff ``ensure_leader()`` is true.  Protocol (the standard
+    client-go shape): acquire when the Lease is absent or its ``renewTime``
+    is older than ``lease_duration``; renew when held by us; otherwise stand
+    by.  Acquire/renew patches carry the read ``resourceVersion`` so a
+    takeover race elects exactly one winner (the loser's patch 409s).
+    """
+
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str,
+        identity: str,
+        name: str = "quantum-operator",
+        lease_duration: float = 30.0,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.identity = identity
+        self.name = name
+        self.lease_duration = lease_duration
+        self.is_leader = False
+        #: monotonic time of the last successful acquire/renew
+        self._last_renew = float("-inf")
+
+    @property
+    def _path(self) -> str:
+        return (
+            f"/apis/coordination.k8s.io/v1/namespaces/{self.namespace}"
+            f"/leases/{self.name}"
+        )
+
+    @staticmethod
+    def _now() -> str:
+        # MicroTime in the K8s wire format (UTC, microseconds, "Z")
+        return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + ".000000Z"
+
+    @staticmethod
+    def _parse(ts: str) -> float:
+        import calendar
+
+        return calendar.timegm(time.strptime(ts[:19], "%Y-%m-%dT%H:%M:%S"))
+
+    def _spec(self) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "renewTime": self._now(),
+        }
+
+    def ensure_leader(self) -> bool:
+        """Acquire or renew the Lease; returns whether we hold it now."""
+        try:
+            try:
+                lease = self.client.get(self._path)
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    raise
+                self.client.post(
+                    f"/apis/coordination.k8s.io/v1/namespaces/{self.namespace}/leases",
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": self.name},
+                        "spec": self._spec(),
+                    },
+                )
+                self.is_leader = True
+                self._last_renew = time.monotonic()
+                return True
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity")
+            renew = spec.get("renewTime") or spec.get("acquireTime")
+            # judge expiry by the DURATION THE HOLDER WROTE, not ours: two
+            # pod versions can run different lease_durations (it derives
+            # from INTERVAL_S), and declaring a slower holder expired by our
+            # faster clock reopens the split-brain window
+            holder_duration = float(
+                spec.get("leaseDurationSeconds") or self.lease_duration
+            )
+            expired = (
+                renew is None
+                or time.time() - self._parse(renew) > holder_duration
+            )
+            if holder == self.identity or holder is None or expired:
+                # optimistic-concurrency precondition: two candidates can
+                # both observe an expired lease; the resourceVersion makes
+                # the apiserver reject the loser's patch with 409 instead of
+                # letting a conflict-free merge-patch elect both (split-brain)
+                body: dict = {"spec": self._spec()}
+                rv = lease.get("metadata", {}).get("resourceVersion")
+                if rv is not None:
+                    body["metadata"] = {"resourceVersion": rv}
+                try:
+                    self.client.patch(self._path, body)
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:  # lost the takeover race: stand down
+                        self.is_leader = False
+                        return False
+                    raise
+                self.is_leader = True
+                self._last_renew = time.monotonic()
+            else:
+                self.is_leader = False
+        except Exception as e:
+            # can't reach/patch the Lease: stand down (fail closed — a
+            # non-leader that patches is worse than a missed interval)
+            print(f"lease error ({self.name}): {e}", flush=True)
+            self.is_leader = False
+        return self.is_leader
+
+    def still_leader(self) -> bool:
+        """Cheap mid-pass leadership check: trust a renew younger than a
+        third of the lease; otherwise re-acquire before answering.  Called
+        immediately before every scale patch so a reconcile pass that
+        outlives the lease (slow apiserver, many targets) cannot keep
+        writing alongside a standby that took over."""
+        if not self.is_leader:
+            return False
+        if time.monotonic() - self._last_renew < self.lease_duration / 3:
+            return True
+        return self.ensure_leader()
+
+
+def start_health_server(
+    operator: QuantumOperator, port: int, stale_after: float = 60.0
+) -> HTTPServer:
+    """``/healthz``: loop ticked within ``stale_after`` s; ``/readyz``: that,
+    plus holding the lease (when electing).  Serves in a daemon thread."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            fresh = time.monotonic() - operator.last_tick < stale_after
+            if self.path == "/healthz":
+                ok = fresh
+            elif self.path == "/readyz":
+                ok = fresh and (
+                    operator.elector is None or operator.elector.is_leader
+                )
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = b"ok" if ok else b"stale"
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = HTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
 
 
 def main() -> None:
     """``python -m k8s_gpu_hpa_tpu.control.operator`` — the operator container.
 
-    Env: NAMESPACE (default "default"), INTERVAL_S (default 5).
+    Env: NAMESPACE (default "default"), INTERVAL_S (default 5), HEALTH_PORT
+    (default 8086; 0 disables), LEASE_NAME (default "quantum-operator"; empty
+    disables leader election), POD_NAME (lease holder identity).
     """
-    operator = QuantumOperator(
-        KubeClient(), namespace=os.environ.get("NAMESPACE", "default")
-    )
+    namespace = os.environ.get("NAMESPACE", "default")
+    client = KubeClient()
+    interval = float(os.environ.get("INTERVAL_S", "5"))
+    lease_name = os.environ.get("LEASE_NAME", "quantum-operator")
+    elector = None
+    if lease_name:
+        elector = LeaseElector(
+            client,
+            namespace,
+            identity=os.environ.get("POD_NAME", os.uname().nodename),
+            name=lease_name,
+            # must outlive a full sleep + reconcile pass, or the lease
+            # expires every cycle and standbys take over spuriously
+            lease_duration=max(30.0, 4 * interval),
+        )
+    operator = QuantumOperator(client, namespace=namespace, elector=elector)
+    health_port = int(os.environ.get("HEALTH_PORT", "8086"))
+    if health_port:
+        # liveness must tolerate a full healthy cycle: interval sleep plus a
+        # slow reconcile, else a long INTERVAL_S crash-loops a healthy pod
+        start_health_server(operator, health_port, stale_after=max(60.0, 4 * interval))
     print(
         f"slice-quantum operator: namespace={operator.namespace}, "
-        f"annotation={QUANTUM_ANNOTATION}",
+        f"annotation={QUANTUM_ANNOTATION}, "
+        f"lease={lease_name or 'disabled'}, health_port={health_port}",
         flush=True,
     )
-    operator.run_forever(interval=float(os.environ.get("INTERVAL_S", "5")))
+    operator.run_forever(interval=interval)
 
 
 if __name__ == "__main__":

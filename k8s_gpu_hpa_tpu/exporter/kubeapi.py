@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import urllib.parse
 import urllib.request
 
 TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
@@ -64,7 +65,7 @@ class KubeApiAttributor:
         return ssl.create_default_context()
 
     def _list_pods(self) -> list[dict]:
-        selector = urllib.request.quote(f"app={self.app_label}")
+        selector = urllib.parse.quote(f"app={self.app_label}")
         url = (
             f"{self.api_base}/api/v1/namespaces/{self.namespace}/pods"
             f"?labelSelector={selector}"

@@ -223,6 +223,7 @@ def main() -> None:
         stopping = True
 
     signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)  # Ctrl-C saves the final checkpoint too
 
     last_report = time.perf_counter()
     last_ckpt_step = gen.stats().steps

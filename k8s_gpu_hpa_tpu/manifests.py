@@ -764,6 +764,13 @@ def quantum_operator_bundle() -> list[dict]:
                     ],
                     "verbs": ["get", "patch"],
                 },
+                {
+                    # leader-election Lease: guards the rolling-update window
+                    # where two operator pods briefly coexist
+                    "apiGroups": ["coordination.k8s.io"],
+                    "resources": ["leases"],
+                    "verbs": ["get", "create", "patch"],
+                },
             ],
         },
         {
@@ -783,6 +790,11 @@ def quantum_operator_bundle() -> list[dict]:
             "metadata": {"name": name, "labels": {"app": name}},
             "spec": {
                 "replicas": 1,
+                # Recreate, not RollingUpdate: a surge pod could never pass
+                # /readyz while the old pod holds the Lease (maxUnavailable
+                # rounds to 0 at one replica), deadlocking the rollout; kill
+                # first, and the successor acquires the lease on expiry
+                "strategy": {"type": "Recreate"},
                 "selector": {"matchLabels": {"app": name}},
                 "template": {
                     "metadata": {"labels": {"app": name}},
@@ -806,8 +818,39 @@ def quantum_operator_bundle() -> list[dict]:
                                             }
                                         },
                                     },
+                                    {
+                                        "name": "POD_NAME",
+                                        "valueFrom": {
+                                            "fieldRef": {
+                                                "fieldPath": "metadata.name"
+                                            }
+                                        },
+                                    },
                                     {"name": "INTERVAL_S", "value": "5"},
+                                    {"name": "HEALTH_PORT", "value": "8086"},
                                 ],
+                                "ports": [
+                                    {"name": "health", "containerPort": 8086}
+                                ],
+                                # /healthz goes stale when the reconcile loop
+                                # hangs; /readyz additionally requires holding
+                                # the leader-election Lease
+                                "livenessProbe": {
+                                    "httpGet": {
+                                        "path": "/healthz",
+                                        "port": "health",
+                                    },
+                                    "initialDelaySeconds": 10,
+                                    "periodSeconds": 15,
+                                },
+                                "readinessProbe": {
+                                    "httpGet": {
+                                        "path": "/readyz",
+                                        "port": "health",
+                                    },
+                                    "initialDelaySeconds": 5,
+                                    "periodSeconds": 10,
+                                },
                                 "resources": {
                                     "requests": {"cpu": "10m", "memory": "64Mi"}
                                 },

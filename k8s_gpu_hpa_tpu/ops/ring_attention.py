@@ -136,7 +136,12 @@ def ring_attention_local(
     l0 = jnp.zeros((b, h, lq), jnp.float32)
     o0 = jnp.zeros((b, h, lq, d), jnp.float32)
     m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
-    # fully-masked rows (causal, all-future block) have l == 0: emit 0
+    # A fully-masked (all-future) block contributes m = NEG_INF with uniform
+    # p = exp(0), so its per-block l is lk, NOT 0 — but _merge annihilates it
+    # against any real block via exp(NEG_INF - m_real) = 0.  Causal rows
+    # always attend to their own position, so after all n hops l > 0 for
+    # every row; the floor only guards the unreachable all-masked case
+    # (and the untouched l0 = 0 init before any real mass arrives).
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 

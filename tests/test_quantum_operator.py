@@ -1,26 +1,30 @@
 """Slice-quantum operator: repair semantics + REST behavior against a fake
-API server, and agreement with the native controller's quantum rule.
+API server, war-freedom against a simulated vanilla HPA, leader election,
+and health probes.
 
 The operator is what makes whole-slice scaling hold on a VANILLA cluster
-(kube-controller-manager has no quantum knob) — its repair rule must match
-control/hpa.py exactly, or the simulated pipeline and the real cluster would
-disagree about slice boundaries.
+(kube-controller-manager has no quantum knob).  Unlike the native controller
+(control/hpa.py) it is a SECOND writer composing with the vanilla HPA, so its
+prime directive is reaching a fixed point: every repair must converge with
+the HPA's next sync instead of starting an unbounded patch war.
 """
 
 import json
 import threading
+import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
-from k8s_gpu_hpa_tpu.control.hpa import HPAController
 from k8s_gpu_hpa_tpu.control.operator import (
     QUANTUM_ANNOTATION,
     KubeClient,
+    LeaseElector,
     QuantumOperator,
     quantum_desired,
+    start_health_server,
 )
-from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
 
 
 # ---- the repair rule ------------------------------------------------------
@@ -35,10 +39,20 @@ def test_growing_partial_slice_rounds_up():
     assert quantum_desired(3, 5, 2, 2, 8) == 4
 
 
-def test_shrinking_partial_slice_releases_hosts():
-    # HPA steady/shrinking at 3 with quantum 2: the odd host serves nothing
-    assert quantum_desired(3, 3, 2, 2, 8) == 2
+def test_steady_off_boundary_holds():
+    """The round-2 flapping bug: at (current=3, desired=3, q=2) the operator
+    used to release down to 2, the vanilla HPA re-asserted 3 on its next
+    sync, and the patch war churned slice pods forever.  Steady must HOLD."""
+    assert quantum_desired(3, 3, 2, 2, 8) == 3
+    assert quantum_desired(5, 5, 2, 2, 8) == 5
+    assert quantum_desired(7, 7, 4, 4, 12) == 7
+
+
+def test_actively_shrinking_releases_hosts():
+    # HPA is moving down (desired < current): release converges with it
     assert quantum_desired(5, 4, 2, 2, 8) == 4
+    assert quantum_desired(5, 2, 2, 2, 8) == 4  # one whole slice at a time
+    assert quantum_desired(3, 1, 2, 2, 8) == 2
 
 
 def test_bounds_snap_inward():
@@ -48,9 +62,30 @@ def test_bounds_snap_inward():
     assert quantum_desired(1, 1, 2, 2, 8) == 2
 
 
-def test_agrees_with_native_controller_repair():
-    """Same scenario through control/hpa.py's partial-slice repair: operator
-    and controller must land on the same count."""
+def test_quantum_exceeding_max_replicas_never_scales_to_zero():
+    """maxReplicas < quantum gives max_q = 0; 'repairing' a live workload to
+    0 replicas would suspend it forever (and the operator skips 0-replica
+    targets, so it could never even undo it).  Hold instead."""
+    assert quantum_desired(2, 3, 4, 1, 3) == 2
+    assert quantum_desired(3, 1, 4, 1, 3) == 3
+
+
+def test_deliberate_divergence_from_native_controller():
+    """Steady off-boundary is the ONE case where operator and native
+    controller disagree, by design: the controller owns the count outright
+    (no second writer), so it releases the stranded hosts; the operator
+    shares the count with the vanilla HPA, so it holds (module docstring).
+    Drives hpa.py's actual repair branch so a drift there fails HERE."""
+    from k8s_gpu_hpa_tpu.control.adapter import (
+        AdapterRule,
+        CustomMetricsAdapter,
+        ObjectReference,
+    )
+    from k8s_gpu_hpa_tpu.control.hpa import HPAController, ObjectMetricSpec
+    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    record = "tpu_test_tensorcore_avg"
 
     class Target:
         replicas = 3
@@ -58,33 +93,43 @@ def test_agrees_with_native_controller_repair():
         def scale_to(self, n):
             self.replicas = n
 
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
     target = Target()
     hpa = HPAController(
         target=target,
-        metrics=[],
-        adapter=None,
-        clock=VirtualClock(),
+        metrics=[
+            ObjectMetricSpec(
+                record, 40.0, ObjectReference("Deployment", "tpu-test", "default")
+            )
+        ],
+        adapter=CustomMetricsAdapter(db, [AdapterRule(series=record)]),
+        clock=clock,
         min_replicas=2,
         max_replicas=8,
         replica_quantum=2,
     )
-    hpa.sync_once()  # no metrics -> hold, but repair applies on next decision
-    # controller holds on metrics-unavailable; drive its repair path directly
-    assert quantum_desired(3, 3, 2, 2, 8) == 2  # operator's answer
-    # the controller's documented repair (hpa.py): release stranded hosts
-    # (its sync with a live metric would do the same via the q-rounding block)
+    # metric exactly on target: desired == current == 3 (steady off-boundary)
+    db.append(record, (("deployment", "tpu-test"), ("namespace", "default")), 40.0)
+    hpa.sync_once()
+    assert target.replicas == 2  # native controller: release the partial slice
+    assert "repair partial slice" in hpa.status.last_reason
+    # same observation through the operator's rule: hold
+    assert quantum_desired(3, 3, 2, 2, 8) == 3
 
 
-# ---- REST behavior --------------------------------------------------------
+# ---- fake API server ------------------------------------------------------
 
 
 class FakeKube:
-    """Enough API server for the operator: HPA list + scale get/patch."""
+    """Enough API server for the operator: HPA list, scale get/patch, and
+    coordination.k8s.io Leases (get/create/patch)."""
 
     def __init__(self):
         self.hpas = []
         self.scales = {}  # "statefulsets/name" -> replicas
-        self.patches = []
+        self.patches = []  # only real HTTP PATCHes (i.e. the operator's)
+        self.leases = {}  # name -> lease doc
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,17 +144,47 @@ class FakeKube:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _lease_name(self):
+                return self.path.rsplit("/", 1)[-1]
+
             def do_GET(self):
                 if "horizontalpodautoscalers" in self.path:
                     return self._send({"items": outer.hpas})
+                if "/leases/" in self.path:
+                    lease = outer.leases.get(self._lease_name())
+                    if lease is None:
+                        return self._send({"message": "not found"}, 404)
+                    return self._send(lease)
                 for key, replicas in outer.scales.items():
                     if f"/{key}/scale" in self.path:
                         return self._send({"spec": {"replicas": replicas}})
                 return self._send({"message": "not found"}, 404)
 
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length))
+                if self.path.endswith("/leases"):
+                    name = body["metadata"]["name"]
+                    body["metadata"]["resourceVersion"] = "1"
+                    outer.leases[name] = body
+                    return self._send(body, 201)
+                return self._send({"message": "not found"}, 404)
+
             def do_PATCH(self):
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length))
+                if "/leases/" in self.path:
+                    name = self._lease_name()
+                    if name not in outer.leases:
+                        return self._send({"message": "not found"}, 404)
+                    lease = outer.leases[name]
+                    rv = lease["metadata"]["resourceVersion"]
+                    claimed = body.get("metadata", {}).get("resourceVersion")
+                    if claimed is not None and claimed != rv:
+                        return self._send({"message": "conflict"}, 409)
+                    lease.setdefault("spec", {}).update(body["spec"])
+                    lease["metadata"]["resourceVersion"] = str(int(rv) + 1)
+                    return self._send(lease)
                 for key in outer.scales:
                     if f"/{key}/scale" in self.path:
                         outer.scales[key] = body["spec"]["replicas"]
@@ -129,7 +204,13 @@ class FakeKube:
         self.server.server_close()
 
 
-def hpa_doc(name="tpu-test-multihost", quantum="2", desired=3, kind="StatefulSet"):
+def hpa_doc(
+    name="tpu-test-multihost",
+    quantum="2",
+    desired=3,
+    kind="StatefulSet",
+    min_replicas=2,
+):
     return {
         "metadata": {
             "name": name,
@@ -137,7 +218,7 @@ def hpa_doc(name="tpu-test-multihost", quantum="2", desired=3, kind="StatefulSet
         },
         "spec": {
             "scaleTargetRef": {"apiVersion": "apps/v1", "kind": kind, "name": name},
-            "minReplicas": 2,
+            "minReplicas": min_replicas,
             "maxReplicas": 8,
         },
         "status": {"desiredReplicas": desired},
@@ -151,29 +232,51 @@ def kube():
     server.close()
 
 
+KEY = "statefulsets/tpu-test-multihost"
+
+
+def vanilla_hpa_sync(kube, desired, key=KEY):
+    """The vanilla kube-controller-manager: re-asserts its desired count on
+    every sync (writes the scale directly; not counted in kube.patches)."""
+    kube.scales[key] = desired
+    kube.hpas[0]["status"]["desiredReplicas"] = desired
+
+
+# ---- REST behavior --------------------------------------------------------
+
+
 def test_operator_repairs_partial_slice_upward(kube):
     kube.hpas = [hpa_doc(desired=5)]  # HPA growing toward 5
-    kube.scales["statefulsets/tpu-test-multihost"] = 3
+    kube.scales[KEY] = 3
     op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
     actions = op.reconcile_once()
-    assert kube.scales["statefulsets/tpu-test-multihost"] == 4
+    assert kube.scales[KEY] == 4
     assert len(actions) == 1
     assert actions[0].from_replicas == 3 and actions[0].to_replicas == 4
     assert "quantum 2" in actions[0].reason
 
 
-def test_operator_releases_stranded_hosts(kube):
-    kube.hpas = [hpa_doc(desired=3)]  # steady at a partial slice
-    kube.scales["statefulsets/tpu-test-multihost"] = 3
+def test_operator_releases_on_active_shrink(kube):
+    kube.hpas = [hpa_doc(desired=2)]  # HPA actively shrinking toward 2
+    kube.scales[KEY] = 3
     op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
     op.reconcile_once()
-    assert kube.scales["statefulsets/tpu-test-multihost"] == 2
+    assert kube.scales[KEY] == 2
+
+
+def test_operator_holds_steady_partial_slice(kube):
+    kube.hpas = [hpa_doc(desired=3)]  # steady at a partial slice
+    kube.scales[KEY] = 3
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    assert op.reconcile_once() == []
+    assert kube.scales[KEY] == 3
+    assert kube.patches == []
 
 
 def test_operator_ignores_unannotated_and_aligned(kube):
     kube.hpas = [hpa_doc(name="plain", quantum=None), hpa_doc(desired=4)]
     kube.scales["statefulsets/plain"] = 3
-    kube.scales["statefulsets/tpu-test-multihost"] = 4  # aligned
+    kube.scales[KEY] = 4  # aligned
     op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
     assert op.reconcile_once() == []
     assert kube.patches == []
@@ -181,17 +284,358 @@ def test_operator_ignores_unannotated_and_aligned(kube):
 
 def test_operator_skips_zero_replicas(kube):
     kube.hpas = [hpa_doc()]
-    kube.scales["statefulsets/tpu-test-multihost"] = 0  # suspended target
+    kube.scales[KEY] = 0  # suspended target
     op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
     assert op.reconcile_once() == []
 
 
-def test_shipped_manifest_annotation_matches_operator():
+def test_malformed_hpa_does_not_starve_the_rest(kube):
+    """One HPA with a typo'd annotation (or a deleted target) must not abort
+    the pass: later HPAs still get their repairs every tick."""
+    broken = hpa_doc(name="broken", quantum="two")  # int() raises
+    orphan = hpa_doc(name="orphan", desired=5)  # scale GET will 404
+    good = hpa_doc(desired=5)
+    kube.hpas = [broken, orphan, good]
+    kube.scales[KEY] = 3
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    actions = op.reconcile_once()
+    assert [a.target for a in actions] == ["StatefulSet/tpu-test-multihost"]
+    assert kube.scales[KEY] == 4
+
+
+def test_operator_holds_when_quantum_exceeds_max(kube, capsys):
+    kube.hpas = [hpa_doc(quantum="4")]  # maxReplicas is 8 -> fine; shrink it
+    kube.hpas[0]["spec"]["maxReplicas"] = 3
+    kube.scales[KEY] = 2
+    kube.hpas[0]["status"]["desiredReplicas"] = 3
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    assert op.reconcile_once() == []
+    assert kube.scales[KEY] == 2  # NOT patched to 0
+    assert "cannot fit one whole slice" in capsys.readouterr().out
+    op.reconcile_once()
+    assert capsys.readouterr().out == ""  # logged once, not every tick
+
+
+# ---- war-freedom: operator + vanilla HPA reach a fixed point --------------
+
+
+def test_fixed_point_steady_off_boundary(kube):
+    """The round-2 war scenario: HPA stuck desiring 3 with quantum 2.
+    Alternate operator reconciles and HPA syncs: the operator must never
+    patch (fixed point immediately), where the old rule ping-ponged 3->2->3
+    forever."""
+    kube.hpas = [hpa_doc(desired=3)]
+    kube.scales[KEY] = 3
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    for _ in range(5):
+        op.reconcile_once()
+        vanilla_hpa_sync(kube, 3)
+    assert kube.patches == []
+    assert kube.scales[KEY] == 3
+
+
+def test_fixed_point_growing_then_steady(kube):
+    """HPA grows 3->5: operator completes the slice (3->4), HPA then asserts
+    5, operator holds at the steady partial slice.  Exactly one patch."""
+    kube.hpas = [hpa_doc(desired=5)]
+    kube.scales[KEY] = 3
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    for _ in range(5):
+        op.reconcile_once()
+        vanilla_hpa_sync(kube, 5)
+    assert kube.patches == [(KEY, 4)]
+    assert kube.scales[KEY] == 5
+
+
+def test_suppression_bounds_min_floor_war(kube):
+    """minReplicas=1 with quantum 2: the HPA's legal floor (1) is below the
+    effective slice floor (2), a war by construction.  The suppression guard
+    bounds it to ONE patch: after the HPA reverts, the operator recognizes
+    the identical (current, hpa_desired) state and stands down."""
+    kube.hpas = [hpa_doc(desired=1, min_replicas=1)]
+    kube.scales[KEY] = 1
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    for _ in range(5):
+        op.reconcile_once()
+        vanilla_hpa_sync(kube, 1)
+    assert kube.patches == [(KEY, 2)]
+
+
+def test_suppression_survives_observing_own_patch(kube):
+    """The shipped config ticks the operator (5 s) faster than the HPA syncs
+    (15 s), so the operator SEES its own patch holding on-boundary before
+    the HPA reverts it.  That observation must not clear the suppression
+    memory, or the war resumes one patch per HPA sync period."""
+    kube.hpas = [hpa_doc(desired=1, min_replicas=1)]
+    kube.scales[KEY] = 1
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    for _ in range(4):  # 4 HPA sync periods...
+        for _ in range(3):  # ...with 3 operator ticks inside each
+            op.reconcile_once()
+        vanilla_hpa_sync(kube, 1)
+    assert kube.patches == [(KEY, 2)]  # one repair ever, then suppressed
+
+
+def test_operator_restart_mid_repair_is_bounded(kube):
+    """Suppression memory is in-process; a restart may re-issue ONE repair,
+    after which suppression re-engages — bounded, not a war."""
+    kube.hpas = [hpa_doc(desired=1, min_replicas=1)]
+    kube.scales[KEY] = 1
+    client = KubeClient(api_base=kube.base, token="t")
+    op = QuantumOperator(client)
+    for _ in range(3):
+        op.reconcile_once()
+        vanilla_hpa_sync(kube, 1)
+    assert kube.patches == [(KEY, 2)]
+    # restart: fresh operator, empty suppression memory
+    op2 = QuantumOperator(client)
+    for _ in range(3):
+        op2.reconcile_once()
+        vanilla_hpa_sync(kube, 1)
+    assert kube.patches == [(KEY, 2), (KEY, 2)]  # one extra patch, then quiet
+
+
+def test_suppression_clears_on_state_change(kube):
+    """A genuinely new (current, hpa_desired) observation re-enables repair."""
+    kube.hpas = [hpa_doc(desired=1, min_replicas=1)]
+    kube.scales[KEY] = 1
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    op.reconcile_once()  # patches 1 -> 2
+    vanilla_hpa_sync(kube, 1)
+    assert op.reconcile_once() == []  # suppressed
+    # the HPA starts growing: new state, repair allowed again
+    vanilla_hpa_sync(kube, 1)
+    kube.hpas[0]["status"]["desiredReplicas"] = 4
+    actions = op.reconcile_once()
+    assert [a.to_replicas for a in actions] == [2]
+
+
+def test_suppression_resets_after_boundary_visit(kube):
+    """Once the HPA acknowledges a genuinely new state (not just the
+    operator observing its own patch), the repair episode is over and the
+    memory is dropped."""
+    kube.hpas = [hpa_doc(desired=5)]
+    kube.scales[KEY] = 3
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+    op.reconcile_once()  # 3 -> 4
+    assert kube.scales[KEY] == 4
+    op.reconcile_once()  # observing our own patch: memory deliberately kept
+    assert op._last_repair != {}
+    # the HPA settles at 4 (desired changes): episode over, memory cleared
+    kube.hpas[0]["status"]["desiredReplicas"] = 4
+    op.reconcile_once()
+    assert op._last_repair == {}
+
+
+# ---- leader election ------------------------------------------------------
+
+
+def test_lease_acquired_when_absent(kube):
+    elector = LeaseElector(
+        KubeClient(api_base=kube.base, token="t"), "default", identity="pod-a"
+    )
+    assert elector.ensure_leader() is True
+    assert kube.leases["quantum-operator"]["spec"]["holderIdentity"] == "pod-a"
+
+
+def test_lease_blocks_second_holder_and_renews_first(kube):
+    client = KubeClient(api_base=kube.base, token="t")
+    a = LeaseElector(client, "default", identity="pod-a")
+    b = LeaseElector(client, "default", identity="pod-b")
+    assert a.ensure_leader() is True
+    assert b.ensure_leader() is False  # fresh lease held by pod-a
+    assert a.ensure_leader() is True  # renew own lease
+
+
+def test_lease_takeover_when_expired(kube):
+    client = KubeClient(api_base=kube.base, token="t")
+    a = LeaseElector(client, "default", identity="pod-a", lease_duration=30)
+    assert a.ensure_leader() is True
+    # age the lease past its duration
+    kube.leases["quantum-operator"]["spec"]["renewTime"] = (
+        "2020-01-01T00:00:00.000000Z"
+    )
+    b = LeaseElector(client, "default", identity="pod-b", lease_duration=30)
+    assert b.ensure_leader() is True
+    assert kube.leases["quantum-operator"]["spec"]["holderIdentity"] == "pod-b"
+
+
+def test_non_leader_tick_does_not_patch(kube):
+    """The single-flight guard: a repair is pending, but a non-leader must
+    not touch the scale subresource."""
+    kube.hpas = [hpa_doc(desired=5)]
+    kube.scales[KEY] = 3
+    client = KubeClient(api_base=kube.base, token="t")
+    leader = LeaseElector(client, "default", identity="pod-a")
+    assert leader.ensure_leader() is True
+    standby = LeaseElector(client, "default", identity="pod-b")
+    op = QuantumOperator(client, elector=standby)
+    assert op.tick() == []
+    assert kube.patches == []
+    # the leader's operator does repair
+    op_leader = QuantumOperator(client, elector=leader)
+    assert len(op_leader.tick()) == 1
+    assert kube.patches == [(KEY, 4)]
+
+
+def test_lease_takeover_race_elects_one_winner(kube):
+    """Two candidates observe the same expired lease; the resourceVersion
+    precondition makes the apiserver 409 the loser's patch (split-brain
+    guard).  Simulated with a client whose read returns a stale snapshot."""
+    client = KubeClient(api_base=kube.base, token="t")
+    a = LeaseElector(client, "default", identity="pod-a", lease_duration=30)
+    assert a.ensure_leader() is True
+    kube.leases["quantum-operator"]["spec"]["renewTime"] = (
+        "2020-01-01T00:00:00.000000Z"
+    )
+
+    class StaleReadClient(KubeClient):
+        def get(self, path):
+            doc = super().get(path)
+            if "/leases/" in path and doc.get("metadata"):
+                # candidate B won between our read and our patch
+                doc["metadata"]["resourceVersion"] = "0"
+            return doc
+
+    loser = LeaseElector(
+        StaleReadClient(api_base=kube.base, token="t"),
+        "default",
+        identity="pod-c",
+        lease_duration=30,
+    )
+    assert loser.ensure_leader() is False
+    assert kube.leases["quantum-operator"]["spec"]["holderIdentity"] == "pod-a"
+
+
+def test_lease_error_fails_closed(kube):
+    """Unreachable lease API -> stand down, never patch without the lease."""
+    client = KubeClient(api_base="http://127.0.0.1:1", token="t")  # dead port
+    elector = LeaseElector(client, "default", identity="pod-a")
+    assert elector.ensure_leader() is False
+
+
+def test_expiry_judged_by_holders_own_duration(kube):
+    """A holder that wrote leaseDurationSeconds=240 (INTERVAL_S=60 rollout)
+    must not be declared expired by a candidate running a 30 s duration —
+    expiry uses the duration the holder recorded in the lease."""
+    client = KubeClient(api_base=kube.base, token="t")
+    slow = LeaseElector(client, "default", identity="pod-new", lease_duration=240)
+    assert slow.ensure_leader() is True
+    # age the renew past the candidate's 30 s but inside the holder's 240 s
+    import calendar
+
+    aged = time.gmtime(calendar.timegm(time.gmtime()) - 60)
+    kube.leases["quantum-operator"]["spec"]["renewTime"] = (
+        time.strftime("%Y-%m-%dT%H:%M:%S", aged) + ".000000Z"
+    )
+    fast = LeaseElector(client, "default", identity="pod-old", lease_duration=30)
+    assert fast.ensure_leader() is False  # holder's own 240 s still running
+    assert kube.leases["quantum-operator"]["spec"]["holderIdentity"] == "pod-new"
+
+
+def test_still_leader_rechecks_after_a_third_of_the_lease(kube):
+    """Mid-pass guard: a fresh renew is trusted without an API call; an aged
+    one re-acquires — and discovers a takeover, aborting the pass before the
+    scale patch (split-brain window closed)."""
+    client = KubeClient(api_base=kube.base, token="t")
+    a = LeaseElector(client, "default", identity="pod-a", lease_duration=30)
+    assert a.ensure_leader() is True
+    assert a.still_leader() is True  # fresh renew: no API round-trip needed
+    # another pod took the lease while pod-a's pass dragged on
+    kube.leases["quantum-operator"]["spec"]["holderIdentity"] = "pod-b"
+    kube.leases["quantum-operator"]["spec"]["renewTime"] = (
+        LeaseElector._now()
+    )
+    a._last_renew = float("-inf")  # age pod-a's last renew past lease/3
+    assert a.still_leader() is False
+
+    # and the operator aborts the pass instead of patching
+    kube.hpas = [hpa_doc(desired=5)]
+    kube.scales[KEY] = 3
+    op = QuantumOperator(client, elector=a)
+    a.is_leader = True  # stale belief from the start of the pass
+    a._last_renew = float("-inf")
+    assert op.reconcile_once() == []
+    assert kube.patches == []
+
+
+# ---- health endpoints -----------------------------------------------------
+
+
+def _http_status(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_health_endpoints(kube):
+    client = KubeClient(api_base=kube.base, token="t")
+    elector = LeaseElector(client, "default", identity="pod-a")
+    op = QuantumOperator(client, elector=elector)
+    server = start_health_server(op, 0, stale_after=60)
+    port = server.server_port
+    try:
+        assert _http_status(port, "/healthz") == 200  # loop just constructed
+        assert _http_status(port, "/readyz") == 503  # not leader yet
+        op.tick()  # acquires the lease
+        assert _http_status(port, "/readyz") == 200
+        op.last_tick = time.monotonic() - 120  # hung loop
+        assert _http_status(port, "/healthz") == 503
+        assert _http_status(port, "/nope") == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_health_without_elector():
+    op = QuantumOperator(KubeClient(api_base="http://x", token="t"))
+    server = start_health_server(op, 0, stale_after=60)
+    try:
+        assert _http_status(server.server_port, "/readyz") == 200
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---- shipped manifest contracts -------------------------------------------
+
+
+def _deploy_docs(name):
     from pathlib import Path
 
     import yaml
 
-    doc = yaml.safe_load(
-        (Path(__file__).parent.parent / "deploy/tpu-test-multihost-hpa.yaml").read_text()
+    return list(
+        yaml.safe_load_all(
+            (Path(__file__).parent.parent / "deploy" / name).read_text()
+        )
     )
-    assert QUANTUM_ANNOTATION in doc["metadata"]["annotations"]
+
+
+def test_shipped_manifest_annotation_matches_operator():
+    docs = _deploy_docs("tpu-test-multihost-hpa.yaml")
+    assert QUANTUM_ANNOTATION in docs[0]["metadata"]["annotations"]
+
+
+def test_shipped_manifest_has_probes_and_lease_rbac():
+    docs = _deploy_docs("quantum-operator.yaml")
+    role = next(d for d in docs if d["kind"] == "Role")
+    lease_rules = [
+        r for r in role["rules"] if r["apiGroups"] == ["coordination.k8s.io"]
+    ]
+    assert lease_rules and set(lease_rules[0]["verbs"]) == {
+        "get",
+        "create",
+        "patch",
+    }
+    deployment = next(d for d in docs if d["kind"] == "Deployment")
+    # Recreate: a RollingUpdate surge pod could never pass /readyz while the
+    # old pod holds the Lease, deadlocking the rollout
+    assert deployment["spec"]["strategy"] == {"type": "Recreate"}
+    container = deployment["spec"]["template"]["spec"]["containers"][0]
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    env = {e["name"] for e in container["env"]}
+    assert {"POD_NAME", "HEALTH_PORT"} <= env
