@@ -198,6 +198,9 @@ class QuantumOperator:
         self._suppressed_logged: set[str] = set()
         #: HPAs whose quantum>maxReplicas misconfig has been logged once
         self._misconfig_logged: set[str] = set()
+        #: HPA name -> last logged reconcile error (log on change, clear on
+        #: success — a deleted target would otherwise spam every tick)
+        self._error_logged: dict[str, str] = {}
 
     def _list_hpas(self) -> list[dict]:
         path = (
@@ -218,13 +221,20 @@ class QuantumOperator:
                 break
             except Exception as e:
                 # one malformed HPA (typo'd annotation, deleted target) must
-                # not starve every other annotated HPA of repairs
+                # not starve every other annotated HPA of repairs — and a
+                # PERSISTENT breakage must not spam every tick: log when the
+                # message changes, clear on the next success
                 name = hpa.get("metadata", {}).get("name", "?")
-                print(
-                    f"reconcile error for HPA {name}: {e} (continuing)",
-                    flush=True,
-                )
+                message = f"{type(e).__name__}: {e}"
+                if self._error_logged.get(name) != message:
+                    self._error_logged[name] = message
+                    print(
+                        f"reconcile error for HPA {name}: {message} "
+                        "(continuing; logged once until it changes)",
+                        flush=True,
+                    )
                 continue
+            self._error_logged.pop(hpa.get("metadata", {}).get("name", "?"), None)
             if action is not None:
                 actions.append(action)
         return actions
@@ -348,7 +358,9 @@ class LeaseElector:
     pod on a cordoned node, or a manually scaled-up Deployment: the patch
     loop runs iff ``ensure_leader()`` is true.  Protocol (the standard
     client-go shape): acquire when the Lease is absent or its ``renewTime``
-    is older than ``lease_duration``; renew when held by us; otherwise stand
+    has sat unchanged — on OUR monotonic clock, never by comparing the
+    holder's wall-clock to ours (NTP skew would elect two leaders) — for the
+    duration the holder recorded; renew when held by us; otherwise stand
     by.  Acquire/renew patches carry the read ``resourceVersion`` so a
     takeover race elects exactly one winner (the loser's patch 409s).
     """
@@ -369,6 +381,12 @@ class LeaseElector:
         self.is_leader = False
         #: monotonic time of the last successful acquire/renew
         self._last_renew = float("-inf")
+        #: (renewTime string, local monotonic at first observation) — expiry
+        #: is judged by how long the holder's renewTime has sat UNCHANGED on
+        #: our own clock, never by comparing their wall-clock to ours
+        #: (client-go does the same; cross-node clock skew otherwise elects
+        #: two leaders)
+        self._observed: tuple[str | None, float] | None = None
 
     @property
     def _path(self) -> str:
@@ -381,12 +399,6 @@ class LeaseElector:
     def _now() -> str:
         # MicroTime in the K8s wire format (UTC, microseconds, "Z")
         return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + ".000000Z"
-
-    @staticmethod
-    def _parse(ts: str) -> float:
-        import calendar
-
-        return calendar.timegm(time.strptime(ts[:19], "%Y-%m-%dT%H:%M:%S"))
 
     def _spec(self) -> dict:
         return {
@@ -418,16 +430,20 @@ class LeaseElector:
             spec = lease.get("spec", {})
             holder = spec.get("holderIdentity")
             renew = spec.get("renewTime") or spec.get("acquireTime")
-            # judge expiry by the DURATION THE HOLDER WROTE, not ours: two
-            # pod versions can run different lease_durations (it derives
-            # from INTERVAL_S), and declaring a slower holder expired by our
-            # faster clock reopens the split-brain window
+            # judge expiry by the DURATION THE HOLDER WROTE (two pod
+            # versions can run different lease_durations), measured as how
+            # long that renewTime has sat UNCHANGED on OUR monotonic clock —
+            # never by subtracting their wall-clock timestamp from ours,
+            # which turns NTP skew into split-brain
             holder_duration = float(
                 spec.get("leaseDurationSeconds") or self.lease_duration
             )
+            now_mono = time.monotonic()
+            if self._observed is None or self._observed[0] != renew:
+                self._observed = (renew, now_mono)
             expired = (
                 renew is None
-                or time.time() - self._parse(renew) > holder_duration
+                or now_mono - self._observed[1] > holder_duration
             )
             if holder == self.identity or holder is None or expired:
                 # optimistic-concurrency precondition: two candidates can

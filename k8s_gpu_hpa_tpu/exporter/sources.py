@@ -280,8 +280,12 @@ class LibtpuSource:
     fetch_bw: bool = True
     fetch_temp_power: bool = True
     _channel: object = field(default=None, repr=False)
-    #: None = untested; probed on the first sweep, sticky afterwards
+    #: None = untested; probed on the first sweep.  Sticky-False only on the
+    #: probe-once path (no capability RPC); when the runtime ADVERTISED the
+    #: metric, a fetch failure is treated as transient (see sample()).
     _bw_supported: bool | None = field(default=None, repr=False)
+    #: True when ListSupportedMetrics explicitly advertised the bw metric
+    _bw_advertised: bool = field(default=False, repr=False)
     #: metric names the runtime advertises via ListSupportedMetrics;
     #: None = not yet asked or the RPC itself is unsupported (older libtpu)
     _supported: set | None = field(default=None, repr=False)
@@ -336,6 +340,7 @@ class LibtpuSource:
         self._supported_probed = False
         self._supported = None
         self._bw_supported = None
+        self._bw_advertised = False
         self._temp_name = None
         self._power_name = None
 
@@ -356,6 +361,9 @@ class LibtpuSource:
             if advertised is not None:
                 if LIBTPU_HBM_BW not in advertised:
                     self._bw_supported = False
+                else:
+                    self._bw_supported = True
+                    self._bw_advertised = True
                 if self.fetch_temp_power:
                     for name in libtpu_proto.CHIP_TEMP_CANDIDATES:
                         if name in advertised:
@@ -374,24 +382,30 @@ class LibtpuSource:
             raise
         bw: dict[int, float] = {}
         if self._bw_supported is not False:
-            # advertised (or unknown on older builds): one failed fetch marks
-            # it unsupported for the daemon's lifetime (don't pay a failing
-            # RPC every sweep), but a failure here must not discard the sweep
             try:
                 bw = self._get_metric(LIBTPU_HBM_BW)
                 self._bw_supported = True
             except Exception:
-                self._bw_supported = False
+                # ADVERTISED by ListSupportedMetrics: a failed fetch (e.g. a
+                # timeout under load) is transient — retry next sweep, don't
+                # let one blip blank the series until reconnect.  Probe-once
+                # path (no capability RPC): sticky-unsupported, so an old
+                # build doesn't pay a failing RPC every second.  Either way
+                # the sweep itself survives (series absent this sweep).
+                if not self._bw_advertised:
+                    self._bw_supported = False
+        # advertised-only families; independent try blocks so a temperature
+        # fetch failure cannot also drop this sweep's power reading
         temp: dict[int, float] = {}
         power: dict[int, float] = {}
-        if self._temp_name or self._power_name:
-            # advertised-only families; a transient fetch failure just leaves
-            # them absent for this sweep
+        if self._temp_name:
             try:
-                if self._temp_name:
-                    temp = self._get_metric(self._temp_name)
-                if self._power_name:
-                    power = self._get_metric(self._power_name)
+                temp = self._get_metric(self._temp_name)
+            except Exception:
+                pass
+        if self._power_name:
+            try:
+                power = self._get_metric(self._power_name)
             except Exception:
                 pass
         chips = []

@@ -54,6 +54,21 @@ class StubLibtpuServer:
     #: itself is absent (client must fall back to probe-once-per-name)
     list_supported_enabled: bool = True
 
+    def _effective_supported(self) -> set[str]:
+        """The names this stub build actually serves: the explicit override,
+        else the four standard families.  GetRuntimeMetric errors outside
+        this set — real old libtpu builds error on unsupported names rather
+        than inventing 0.0, and the client's probe-once fallback depends on
+        that distinction (it must not mark an absent metric 'supported')."""
+        if self.supported_metrics is not None:
+            return set(self.supported_metrics)
+        return {
+            sources.LIBTPU_DUTY_CYCLE,
+            sources.LIBTPU_HBM_USAGE,
+            sources.LIBTPU_HBM_TOTAL,
+            sources.LIBTPU_HBM_BW,
+        }
+
     def _value(self, name: str, device_id: int) -> float:
         if self.metric_fn is not None:
             return self.metric_fn(name, device_id)
@@ -72,6 +87,12 @@ class StubLibtpuServer:
     def _handle(self, request: bytes, context) -> bytes:
         name = decode_metric_request(request)
         self.request_log.append(name)
+        if name not in self._effective_supported():
+            import grpc
+
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"unsupported metric {name}"
+            )
         ids = self.device_ids or list(range(self.num_chips))
         per_device = {i: self._value(name, i) for i in ids}
         # libtpu reports HBM byte counts as int64 gauges, percentages as

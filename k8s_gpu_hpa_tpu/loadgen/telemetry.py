@@ -13,11 +13,14 @@ Two of the schema's gauges have no device-counter source on every node:
 
 Mechanism (the TPU-side analog of dcgm-exporter's hostPath plumbing,
 dcgm-exporter.yaml:50-62, with the direction reversed): each workload pod
-atomically writes ``$TPU_TELEMETRY_DIR/<pod>.json`` on a hostPath volume
-shared with the exporter DaemonSet; the exporter's daemon
-(exporter/selfreport.py) reads fresh files each sweep and merges the values
-into chips attributed to that pod.  Attribution stays honest — a pod can only
-ever fill gauges for chips the kubelet says it owns.
+atomically writes ``$TPU_TELEMETRY_DIR/<namespace>_<pod>.json`` on a hostPath
+volume shared with the exporter DaemonSet; the shipped manifests mount the
+workload side with ``subPathExpr: $(POD_NAMESPACE)_$(POD_NAME)``, so the pod
+physically sees only its own subdirectory and cannot forge a co-resident
+pod's report.  The exporter's daemon (exporter/selfreport.py) reads fresh
+files each sweep and merges the values into chips attributed to that pod.
+Attribution stays honest — a pod can only ever fill gauges for chips the
+kubelet says it owns.
 
 Writes are tmp+rename (atomic on one filesystem) so the reader never sees a
 torn JSON; files older than the reader's staleness window are ignored, so a
@@ -92,7 +95,12 @@ class TelemetryWriter:
 
     @property
     def path(self) -> str:
-        return os.path.join(self.directory, f"{self.pod}.json")
+        # namespace-qualified: two same-named pods in different namespaces on
+        # one node must not clobber each other's reports (the reader keys by
+        # (namespace, pod)).  "_" cannot appear in either (DNS labels), so
+        # the name is unambiguous — and it doubles as the subdirectory name
+        # the shipped manifests mount per-pod via subPathExpr.
+        return os.path.join(self.directory, f"{self.namespace}_{self.pod}.json")
 
     def write(
         self,

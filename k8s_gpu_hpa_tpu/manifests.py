@@ -222,9 +222,17 @@ def telemetry_volume() -> dict:
 
 
 def telemetry_volume_mount(read_only: bool = False) -> dict:
+    """Writable mounts (workloads) get a per-pod ``subPathExpr``: the kubelet
+    mounts only ``<ns>_<pod>/`` of the shared hostPath into the container, so
+    a pod PHYSICALLY cannot deliver a report claiming a co-resident pod's
+    identity — the reader additionally requires a report's claimed identity
+    to match its subdirectory name (exporter/selfreport.py).  The exporter's
+    read-only mount sees the whole directory."""
     mount = {"name": "tpu-telemetry", "mountPath": TELEMETRY_HOST_PATH}
     if read_only:
         mount["readOnly"] = True
+    else:
+        mount["subPathExpr"] = "$(POD_NAMESPACE)_$(POD_NAME)"
     return mount
 
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from k8s_gpu_hpa_tpu.metrics.schema import Sample, TPU_TENSORCORE_UTIL
+from k8s_gpu_hpa_tpu.metrics.schema import Sample, TPU_DUTY_CYCLE, TPU_TENSORCORE_UTIL
 from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
 
 Vector = list[Sample]
@@ -399,28 +399,90 @@ def pipeline_alert_rules(
 
 
 def flat_zero_alert(record: str, app: str) -> AlertRule:
-    """``record == 0 and on() count(kube_pod_labels{label_app=app}) > 0`` —
-    the autoscale series is present but pinned at zero while the workload has
-    pods.  Catches what Absent cannot: a source feeding fake zeros (round 1's
-    bw degradation) or a broken self-report channel.  Two minutes of ``for:``
-    tolerates genuinely idle-but-alive workloads briefly at 0."""
+    """The autoscale series is present but pinned at zero while the workload
+    is demonstrably active.  Catches what Absent cannot: a source feeding
+    fake zeros (round 1's bw degradation) or a broken self-report channel.
+
+    Three conjuncts, each killing a false-fire mode:
+
+    - ``record == 0`` — the broken signal itself;
+    - ``count(app pods joined to kube_pod_status_phase{phase="Running"}) > 0``
+      — kube-state-metrics exports ``kube_pod_labels`` for Pending/Succeeded
+      pods too, so a bare label count could fire with nothing actually
+      running (round-2 VERDICT weak #7);
+    - ``max(app pods' duty cycle) > 0`` — a genuinely idle workload
+      (intensity knob at 0) legitimately sits at 0 for hours; only a zero
+      signal while the chips are measurably busy proves the CHANNEL is
+      broken rather than the load absent (advisor round 2).  When the duty
+      series itself is missing, TpuExporterDown/SignalAbsent cover it; when
+      a wedged source feeds fake zeros to EVERY family (duty included, so
+      this gate is also 0), ``device_counters_dead_alert`` covers it — a
+      real chip never reports 0 total HBM, idle or not.
+    """
+    running_pods = Aggregate(
+        "count",
+        MulOnGroupLeft(
+            left=MaxBy(("pod",), Select("kube_pod_labels", {"label_app": app})),
+            right=MaxBy(
+                ("pod",),
+                Cmp(
+                    Select("kube_pod_status_phase", {"phase": "Running"}),
+                    "==",
+                    1,
+                ),
+            ),
+            on=("pod",),
+        ),
+    )
+    app_duty = Aggregate(
+        "max",
+        MulOnGroupLeft(
+            left=MaxBy(("pod",), Select(TPU_DUTY_CYCLE)),
+            right=MaxBy(
+                ("pod",), Select("kube_pod_labels", {"label_app": app})
+            ),
+            on=("pod",),
+        ),
+    )
     return AlertRule(
         alert="TpuAutoscaleSignalFlatZero",
         expr=AndOn(
-            Cmp(Select(record), "==", 0),
-            Cmp(
-                Aggregate("count", Select("kube_pod_labels", {"label_app": app})),
-                ">",
-                0,
+            AndOn(
+                Cmp(Select(record), "==", 0),
+                Cmp(running_pods, ">", 0),
             ),
+            Cmp(app_duty, ">", 0),
         ),
         for_seconds=120.0,
         labels={"severity": "warning", "record": record},
         annotations={
             "summary": f"autoscale series {record} is present but flat zero "
-            f"while {app} pods are running: the device counter or workload "
-            "self-report feeding it is broken, and the HPA will never scale "
-            "this rung"
+            f"while {app} pods are Running with nonzero duty cycle: the "
+            "device counter or workload self-report feeding it is broken, "
+            "and the HPA will never scale this rung"
+        },
+    )
+
+
+def device_counters_dead_alert() -> AlertRule:
+    """``max(tpu_hbm_memory_total_bytes) == 0`` — every chip claims zero
+    TOTAL HBM, which no real chip reports even fully idle: the source is
+    serving zeros, not measurements (a wedged libtpu answering 0.0 for every
+    metric).  This is the all-zeros degradation mode the flat-zero alert's
+    duty-cycle gate cannot see (duty is fake-0 too), and it carries no idle
+    noise because HBM capacity is load-independent.  Exporter staleness/
+    outage are different failure modes with their own alerts."""
+    return AlertRule(
+        alert="TpuDeviceCountersDead",
+        expr=Cmp(
+            Aggregate("max", Select("tpu_hbm_memory_total_bytes")), "==", 0
+        ),
+        for_seconds=120.0,
+        labels={"severity": "critical"},
+        annotations={
+            "summary": "every chip reports 0 total HBM bytes: the metric "
+            "source is serving zeros, not measurements — all utilization "
+            "gauges (and the HPA signals built on them) are fake"
         },
     )
 
@@ -456,6 +518,7 @@ def shipped_alert_rules() -> list[AlertRule]:
     and its flatline must page even while the tensorcore rung is healthy."""
     return pipeline_alert_rules() + [
         flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve"),
+        device_counters_dead_alert(),
         chip_hot_alert(),
     ]
 

@@ -113,46 +113,65 @@ def test_stale_exporter_fires_exporter_down_alert():
     assert {"TpuExporterDown", "TpuExporterStale", "TpuAutoscaleSignalAbsent"} <= firing
 
 
-def test_flat_zero_alert_fires_only_while_pods_run():
+def test_flat_zero_alert_fires_only_for_running_active_pods():
     """The present-but-dead mode (VERDICT.md weak #3): the autoscale series
-    exists, pinned at 0, while the workload has pods — Absent never fires, so
-    FlatZero must.  With no pods, a zero series is normal (nothing running)."""
+    exists, pinned at 0, while the workload is demonstrably active.  Three
+    guarded false-fire modes: no pods at all; pods that exist but are only
+    Pending (kube-state-metrics exports kube_pod_labels for those too,
+    VERDICT r2 weak #7); Running pods that are genuinely idle (duty 0 —
+    intensity knob at zero must not page, advisor r2)."""
     from k8s_gpu_hpa_tpu.metrics.rules import flat_zero_alert
 
     clock = VirtualClock()
     db = TimeSeriesDB(clock)
     alert = flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve")
     evaluator = RuleEvaluator(db, [], alerts=[alert])
+    POD = "tpu-serve-abc"
+
+    def tick(
+        signal=0.0, labeled=False, phase=None, duty=None, steps=1
+    ):
+        for _ in range(steps):
+            db.append("tpu_serve_hbm_bw_avg", (("deployment", "tpu-serve"),), signal)
+            if labeled:
+                db.append(
+                    "kube_pod_labels",
+                    (("label_app", "tpu-serve"), ("pod", POD)),
+                    1.0,
+                )
+            if phase is not None:
+                for p in ("Pending", "Running", "Succeeded"):
+                    db.append(
+                        "kube_pod_status_phase",
+                        (("phase", p), ("pod", POD)),
+                        1.0 if p == phase else 0.0,
+                    )
+            if duty is not None:
+                db.append("tpu_duty_cycle", (("chip", "0"), ("pod", POD)), duty)
+            evaluator.evaluate_once()
+            clock.advance(1.0)
 
     # Phase 1: series flat-zero but NO pods → never fires
-    for _ in range(180):
-        db.append("tpu_serve_hbm_bw_avg", (("deployment", "tpu-serve"),), 0.0)
-        evaluator.evaluate_once()
-        clock.advance(1.0)
+    tick(steps=180)
     assert not alert.firing
 
-    # Phase 2: pods appear, series still flat-zero → pending then firing
+    # Phase 2: pod exists but only PENDING (labels exported anyway) → no fire
+    tick(labeled=True, phase="Pending", duty=0.0, steps=180)
+    assert not alert.firing
+
+    # Phase 3: Running but genuinely idle (duty 0, intensity knob down) → no fire
+    tick(labeled=True, phase="Running", duty=0.0, steps=180)
+    assert not alert.firing
+
+    # Phase 4: Running AND busy while the signal stays 0 → pending, then fires
     for t in range(180):
-        db.append("tpu_serve_hbm_bw_avg", (("deployment", "tpu-serve"),), 0.0)
-        db.append(
-            "kube_pod_labels",
-            (("label_app", "tpu-serve"), ("pod", "tpu-serve-abc")),
-            1.0,
-        )
-        evaluator.evaluate_once()
+        tick(labeled=True, phase="Running", duty=75.0)
         if t < 119:
             assert not alert.firing, f"fired early at t={t}"
-        clock.advance(1.0)
     assert alert.firing
 
-    # Phase 3: signal recovers → resets immediately
-    db.append("tpu_serve_hbm_bw_avg", (("deployment", "tpu-serve"),), 42.0)
-    db.append(
-        "kube_pod_labels",
-        (("label_app", "tpu-serve"), ("pod", "tpu-serve-abc")),
-        1.0,
-    )
-    evaluator.evaluate_once()
+    # Phase 5: signal recovers → resets immediately
+    tick(signal=42.0, labeled=True, phase="Running", duty=75.0)
     assert not alert.firing
 
 

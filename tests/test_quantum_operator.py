@@ -447,17 +447,51 @@ def test_lease_blocks_second_holder_and_renews_first(kube):
     assert a.ensure_leader() is True  # renew own lease
 
 
+def _age_observation(elector, seconds):
+    """Pretend the elector has watched the current renewTime sit unchanged
+    for ``seconds`` on its local monotonic clock."""
+    renew, _ = elector._observed
+    elector._observed = (renew, time.monotonic() - seconds)
+
+
 def test_lease_takeover_when_expired(kube):
+    """Expiry is an OBSERVED property: a candidate takes over only after
+    watching the renewTime sit unchanged for the holder's duration on its
+    own monotonic clock — never by comparing the holder's wall-clock
+    timestamp to local time (NTP skew must not elect two leaders)."""
     client = KubeClient(api_base=kube.base, token="t")
     a = LeaseElector(client, "default", identity="pod-a", lease_duration=30)
     assert a.ensure_leader() is True
-    # age the lease past its duration
+    b = LeaseElector(client, "default", identity="pod-b", lease_duration=30)
+    # first sighting: even an ANCIENT wall-clock renewTime is not expiry —
+    # pod-b has no local observation history yet
     kube.leases["quantum-operator"]["spec"]["renewTime"] = (
         "2020-01-01T00:00:00.000000Z"
     )
-    b = LeaseElector(client, "default", identity="pod-b", lease_duration=30)
+    assert b.ensure_leader() is False
+    # renewTime unchanged for a full duration on pod-b's clock: takeover
+    _age_observation(b, 31)
     assert b.ensure_leader() is True
     assert kube.leases["quantum-operator"]["spec"]["holderIdentity"] == "pod-b"
+
+
+def test_skewed_clock_does_not_elect_two_leaders(kube):
+    """The split-brain vector: a standby whose wall clock runs far ahead of
+    the holder's.  Wall-clock deltas are never consulted, so a renewTime
+    'in the past' by 10 minutes is still fresh if it keeps changing."""
+    client = KubeClient(api_base=kube.base, token="t")
+    a = LeaseElector(client, "default", identity="pod-a", lease_duration=30)
+    assert a.ensure_leader() is True
+    b = LeaseElector(client, "default", identity="pod-b", lease_duration=30)
+    for _ in range(3):
+        # holder renews with timestamps a skewed standby would read as
+        # 10 minutes stale; each CHANGED renewTime resets b's observation
+        kube.leases["quantum-operator"]["spec"]["renewTime"] = (
+            f"2020-01-01T00:0{_}:00.000000Z"
+        )
+        assert b.ensure_leader() is False
+        _age_observation(b, 20)  # under the 30 s duration: still not expired
+        assert b.ensure_leader() is False
 
 
 def test_non_leader_tick_does_not_patch(kube):
@@ -517,20 +551,20 @@ def test_lease_error_fails_closed(kube):
 def test_expiry_judged_by_holders_own_duration(kube):
     """A holder that wrote leaseDurationSeconds=240 (INTERVAL_S=60 rollout)
     must not be declared expired by a candidate running a 30 s duration —
-    expiry uses the duration the holder recorded in the lease."""
+    expiry uses the duration the holder recorded in the lease, measured on
+    the candidate's own observation clock."""
     client = KubeClient(api_base=kube.base, token="t")
     slow = LeaseElector(client, "default", identity="pod-new", lease_duration=240)
     assert slow.ensure_leader() is True
-    # age the renew past the candidate's 30 s but inside the holder's 240 s
-    import calendar
-
-    aged = time.gmtime(calendar.timegm(time.gmtime()) - 60)
-    kube.leases["quantum-operator"]["spec"]["renewTime"] = (
-        time.strftime("%Y-%m-%dT%H:%M:%S", aged) + ".000000Z"
-    )
     fast = LeaseElector(client, "default", identity="pod-old", lease_duration=30)
-    assert fast.ensure_leader() is False  # holder's own 240 s still running
+    assert fast.ensure_leader() is False  # first sighting
+    # unchanged for 60 s: past pod-old's OWN 30 s, inside the holder's 240 s
+    _age_observation(fast, 60)
+    assert fast.ensure_leader() is False
     assert kube.leases["quantum-operator"]["spec"]["holderIdentity"] == "pod-new"
+    # unchanged past the holder's recorded 240 s: genuinely dead, take over
+    _age_observation(fast, 241)
+    assert fast.ensure_leader() is True
 
 
 def test_still_leader_rechecks_after_a_third_of_the_lease(kube):

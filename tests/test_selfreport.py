@@ -92,6 +92,97 @@ def test_writer_rate_limits_and_clears(tmp_path):
     assert SelfReportReader(str(tmp_path)).read() == {}
 
 
+def test_writer_filename_is_namespace_qualified(tmp_path):
+    """Two same-named pods in different namespaces on one node must not
+    clobber each other's reports (the reader keys by (namespace, pod))."""
+    TelemetryWriter(directory=str(tmp_path), pod="p", namespace="ns-a").write(
+        duty_cycle_pct=10.0, force=True
+    )
+    TelemetryWriter(directory=str(tmp_path), pod="p", namespace="ns-b").write(
+        duty_cycle_pct=20.0, force=True
+    )
+    reports = SelfReportReader(str(tmp_path)).read()
+    assert reports[("ns-a", "p")].duty_cycle_pct == 10.0
+    assert reports[("ns-b", "p")].duty_cycle_pct == 20.0
+
+
+# ---- per-pod subPathExpr subdirectories (physical spoof gate) -------------
+
+
+def test_subdir_report_with_matching_identity_accepted(tmp_path):
+    """The production layout: the kubelet mounts <ns>_<pod>/ into the pod
+    (subPathExpr), so its report lands one level down.  The reader accepts
+    it when the claimed identity matches the directory name."""
+    poddir = tmp_path / "default_tpu-test-abc"
+    poddir.mkdir()
+    TelemetryWriter(
+        directory=str(poddir), pod="tpu-test-abc", namespace="default"
+    ).write(tensorcore_util_pct=42.0, force=True)
+    reports = SelfReportReader(str(tmp_path)).read()
+    assert reports[("default", "tpu-test-abc")].tensorcore_util_pct == 42.0
+
+
+def test_forged_coresident_report_physically_impossible(tmp_path):
+    """The round-2 spoof hole, closed: pod A can only write inside ITS OWN
+    subPathExpr subdirectory, and a report there claiming co-resident pod
+    B's identity is dropped on the identity/directory mismatch — even though
+    B IS in the kubelet attribution table (the old gate let this through)."""
+    attacker_dir = tmp_path / "default_evil-pod"
+    attacker_dir.mkdir()
+    # the forge: evil-pod writes a report claiming victim-pod's identity
+    TelemetryWriter(
+        directory=str(attacker_dir), pod="victim-pod", namespace="default"
+    ).write(tensorcore_util_pct=99.0, queue_depth=1e6, force=True)
+    reports = SelfReportReader(str(tmp_path)).read()
+    assert reports == {}  # forged identity never leaves the reader
+    # and the attacker's honest reports still work
+    TelemetryWriter(
+        directory=str(attacker_dir), pod="evil-pod", namespace="default"
+    ).write(duty_cycle_pct=5.0, force=True)
+    reports = SelfReportReader(str(tmp_path)).read()
+    assert set(reports) == {("default", "evil-pod")}
+
+
+def test_shipped_workload_manifests_mount_per_pod_subpath():
+    """Every writable telemetry mount in the shipped manifests carries the
+    per-pod subPathExpr (the physical gate); the exporter's stays read-only
+    over the whole directory."""
+    from pathlib import Path
+
+    import yaml
+
+    deploy = Path(__file__).parent.parent / "deploy"
+    for name in [
+        "tpu-test-deployment.yaml",
+        "tpu-serve-deployment.yaml",
+        "tpu-train-deployment.yaml",
+        "tpu-test-v5e8-deployment.yaml",
+    ]:
+        doc = yaml.safe_load((deploy / name).read_text())
+        containers = doc["spec"]["template"]["spec"]["containers"]
+        mounts = [
+            m
+            for c in containers
+            for m in c.get("volumeMounts", [])
+            if m["name"] == "tpu-telemetry"
+        ]
+        assert mounts, name
+        for m in mounts:
+            assert m["subPathExpr"] == "$(POD_NAMESPACE)_$(POD_NAME)", name
+    exporter = list(
+        yaml.safe_load_all((deploy / "tpu-metrics-exporter.yaml").read_text())
+    )
+    ds = next(d for d in exporter if d["kind"] == "DaemonSet")
+    mounts = [
+        m
+        for c in ds["spec"]["template"]["spec"]["containers"]
+        for m in c.get("volumeMounts", [])
+        if m["name"] == "tpu-telemetry"
+    ]
+    assert mounts and all(m.get("readOnly") for m in mounts)
+    assert all("subPathExpr" not in m for m in mounts)
+
+
 # ---- merge semantics ------------------------------------------------------
 
 

@@ -129,8 +129,9 @@ def test_hbm_bandwidth_served_when_supported():
 
 
 def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
-    """Older libtpu: the bandwidth metric errors.  The sweep must survive
-    (bw=0), and the failing RPC must not be retried every second."""
+    """Older libtpu (no ListSupportedMetrics RPC): the bandwidth metric
+    errors on fetch.  The sweep must survive (bw absent), and the failing
+    probe must not be retried every second (sticky on the probe path)."""
     from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
 
     def metric_fn(name, i):
@@ -138,7 +139,9 @@ def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
             raise KeyError(f"unknown metric {name}")
         return 50.0
 
-    with StubLibtpuServer(num_chips=2, metric_fn=metric_fn) as server:
+    with StubLibtpuServer(
+        num_chips=2, metric_fn=metric_fn, list_supported_enabled=False
+    ) as server:
         source = LibtpuSource(address=server.address)
         try:
             chips = source.sample()
@@ -149,6 +152,58 @@ def test_hbm_bandwidth_probe_degrades_once_when_unsupported():
             assert source._bw_supported is False
             source.sample()
             assert server.request_log.count(LIBTPU_HBM_BW) == 1  # sticky
+        finally:
+            source.close()
+
+
+def test_probe_fallback_respects_unsupported_name_errors():
+    """Old build modeled honestly: no capability RPC AND unsupported names
+    abort with NOT_FOUND (the stub no longer invents 0.0 for any name).  The
+    probe-once fallback must mark bw unsupported, not 'supported with a fake
+    0' — the exact degradation the capability gating exists to kill."""
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
+    with StubLibtpuServer(
+        num_chips=1,
+        list_supported_enabled=False,
+        supported_metrics=[LIBTPU_DUTY_CYCLE, LIBTPU_HBM_USAGE, LIBTPU_HBM_TOTAL],
+    ) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            assert source.supported_metrics() is None
+            chips = source.sample()
+            assert source._bw_supported is False
+            assert chips[0].hbm_bw_util is None
+            source.sample()
+            assert server.request_log.count(LIBTPU_HBM_BW) == 1  # probed once
+        finally:
+            source.close()
+
+
+def test_advertised_bandwidth_fetch_failure_is_transient():
+    """When ListSupportedMetrics ADVERTISED the bw metric, one failed fetch
+    (timeout under load) must not blank the series until reconnect — the
+    next sweep retries and recovers."""
+    from k8s_gpu_hpa_tpu.exporter.sources import LIBTPU_HBM_BW
+
+    calls = {"bw": 0}
+
+    def metric_fn(name, i):
+        if name == LIBTPU_HBM_BW:
+            calls["bw"] += 1
+            if calls["bw"] == 1:
+                raise TimeoutError("transient blip")
+            return 42.0
+        return 50.0
+
+    with StubLibtpuServer(num_chips=1, metric_fn=metric_fn) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert chips[0].hbm_bw_util is None  # this sweep: absent
+            assert source._bw_supported is True  # but NOT sticky-unsupported
+            chips = source.sample()
+            assert chips[0].hbm_bw_util == 42.0  # recovered
         finally:
             source.close()
 
@@ -205,6 +260,39 @@ def test_temperature_power_served_when_advertised():
             chips = source.sample()
             assert [c.temperature_c for c in chips] == [55.0, 55.0]
             assert [c.power_w for c in chips] == [120.0, 120.0]
+        finally:
+            source.close()
+
+
+def test_temperature_failure_does_not_drop_power():
+    """temp and power are fetched in independent try blocks: a temperature
+    fetch failure must not also blank this sweep's power reading."""
+    from k8s_gpu_hpa_tpu.exporter import libtpu_proto
+
+    temp_name = libtpu_proto.CHIP_TEMP_CANDIDATES[0]
+    advertised = [
+        LIBTPU_DUTY_CYCLE,
+        LIBTPU_HBM_USAGE,
+        LIBTPU_HBM_TOTAL,
+        temp_name,
+        libtpu_proto.CHIP_POWER_CANDIDATES[0],
+    ]
+
+    def metric_fn(name, i):
+        if name == temp_name:
+            raise TimeoutError("thermal sensor blip")
+        if name in libtpu_proto.CHIP_POWER_CANDIDATES:
+            return 120.0
+        return 50.0
+
+    with StubLibtpuServer(
+        num_chips=1, supported_metrics=advertised, metric_fn=metric_fn
+    ) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            chips = source.sample()
+            assert chips[0].temperature_c is None
+            assert chips[0].power_w == 120.0
         finally:
             source.close()
 
