@@ -34,6 +34,36 @@ def _cmd_gen_pipeline(args: argparse.Namespace) -> int:
         "duty-cycle": schema.TPU_DUTY_CYCLE,
         "hbm-bw": schema.TPU_HBM_BW_UTIL,
     }[args.metric]
+    node_selector = None
+    if args.node_selector:
+        node_selector = {}
+        for item in args.node_selector:
+            key, sep, value = item.partition("=")
+            if not sep or not key:
+                print(
+                    f"--node-selector {item!r}: expected KEY=VALUE", file=sys.stderr
+                )
+                return 2
+            node_selector[key] = value
+    tolerations = None
+    if args.toleration:
+        tolerations = []
+        for item in args.toleration:
+            head, sep, effect = item.rpartition(":")
+            if not sep or not head or not effect:
+                print(
+                    f"--toleration {item!r}: expected KEY[=VALUE]:EFFECT",
+                    file=sys.stderr,
+                )
+                return 2
+            key, eq, value = head.partition("=")
+            tol: dict = {"key": key, "effect": effect}
+            if eq:
+                tol["operator"] = "Equal"
+                tol["value"] = value
+            else:
+                tol["operator"] = "Exists"
+            tolerations.append(tol)
     spec = manifests.PipelineSpec(
         app=args.app,
         device_metric=metric,
@@ -47,6 +77,8 @@ def _cmd_gen_pipeline(args: argparse.Namespace) -> int:
         hosts_per_slice=args.hosts_per_slice,
         min_slices=args.min_slices,
         max_slices=args.max_slices,
+        node_selector=node_selector,
+        tolerations=tolerations,
     )
     files = manifests.render_pipeline(spec)
     if args.out:
@@ -140,6 +172,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     gen.add_argument("--min-slices", type=int, default=1)
     gen.add_argument("--max-slices", type=int, default=4)
+    gen.add_argument(
+        "--node-selector",
+        action="append",
+        metavar="KEY=VALUE",
+        help="replace the GKE TPU node labels with hand-applied ones "
+        "(repeatable; non-GKE clusters — see README 'Non-GKE clusters'). "
+        "Also renders a matching exporter DaemonSet into the pipeline",
+    )
+    gen.add_argument(
+        "--toleration",
+        action="append",
+        metavar="KEY[=VALUE]:EFFECT",
+        help="replace the default google.com/tpu:NoSchedule toleration "
+        "(repeatable; KEY=VALUE:EFFECT tolerates Equal, KEY:EFFECT Exists)",
+    )
     gen.add_argument("-o", "--out", help="directory to write files (default: stdout)")
 
     sim = sub.add_parser(

@@ -262,8 +262,19 @@ def probe_libtpu(address: str = "localhost:8431", timeout: float = 5.0) -> int:
                 print(
                     f"[ok ] ListSupportedMetrics: {len(names)} metrics advertised"
                 )
+                unmapped = sorted(set(names) - libtpu_proto.CONSUMED_METRICS)
                 for n in sorted(names):
-                    print(f"       {n}")
+                    marker = "  <- unmapped" if n in unmapped else ""
+                    print(f"       {n}{marker}")
+                if unmapped:
+                    print(
+                        f"[-- ] {len(unmapped)} advertised metric(s) this "
+                        "exporter does not consume — if any is a "
+                        "temperature/power family, please report the exact "
+                        "name so the speculative candidates "
+                        "(exporter/libtpu_proto.py) can be replaced with "
+                        "observed truth"
+                    )
                 if names:
                     validated += 1
                 else:

@@ -64,6 +64,13 @@ class ExporterDaemon:
         self._last_attribution = -float("inf")
         self._attribution: dict[int, tuple[str, str]] = {}
         self.sweeps = 0
+        self._unmapped_logged = False
+        #: optional producer of (queue, namespace, pod, depth) rows, polled
+        #: every sweep.  Production queue gauges come from workload
+        #: self-reports (the selfreport path below); this hook is the stub
+        #: analog — the kind-e2e harness drives the External rung with a
+        #: file knob the way STUB_UTIL_FILE drives utilization.
+        self.queue_fn = None
 
     @property
     def port(self) -> int:
@@ -85,6 +92,7 @@ class ExporterDaemon:
                 pass  # kubelet briefly unavailable: keep last mapping
         try:
             chips = self.source.sample()
+            queue_rows = list(self.queue_fn()) if self.queue_fn is not None else []
             if self.selfreport is not None:
                 # fill gauges only the workload can measure (tensorcore MXU
                 # rate; bw fallback), gated by kubelet attribution: a report
@@ -96,15 +104,34 @@ class ExporterDaemon:
                 chips = merge_reports(chips, self._attribution, reports)
                 # per-pod serving-queue depth (the External rung's demand
                 # signal, tpu_test_queue_depth{queue=...})
-                self.native.set_queue_gauges(
-                    [
-                        (r.queue, r.namespace, r.pod, r.queue_depth)
-                        for r in reports.values()
-                        if r.queue_depth is not None and r.queue
-                    ]
+                queue_rows.extend(
+                    (r.queue, r.namespace, r.pod, r.queue_depth)
+                    for r in reports.values()
+                    if r.queue_depth is not None and r.queue
                 )
+            if self.selfreport is not None or self.queue_fn is not None:
+                # ONE replace per sweep: set_queue_gauges is atomic, so the
+                # self-reported and hook-produced rows must land together or
+                # the later call would silently erase the earlier one's
+                self.native.set_queue_gauges(queue_rows)
             self.native.push(chips)
             self.sweeps += 1
+            if not self._unmapped_logged:
+                # once, after the first good sweep: advertised-but-unconsumed
+                # names are field intelligence — on real hardware they reveal
+                # the ACTUAL thermal/power metric names so the speculative
+                # candidates (libtpu_proto) can be replaced with truth
+                self._unmapped_logged = True
+                unmapped_fn = getattr(self.source, "unmapped_advertised", None)
+                if unmapped_fn is not None:
+                    unmapped = unmapped_fn()
+                    if unmapped:
+                        print(
+                            "libtpu advertises metrics this exporter does not "
+                            "consume (please report these names upstream): "
+                            + ", ".join(unmapped),
+                            flush=True,
+                        )
         except Exception:
             pass  # source hiccup: freshness watchdog flips `up` to 0
 
@@ -205,6 +232,30 @@ def main() -> None:
         selfreport=selfreport,
         metric_fields=fields or None,
     )
+    # Stub queue knob (kind-e2e External rung): STUB_QUEUE_NAME (comma
+    # separated) makes the stub serve tpu_test_queue_depth{queue=...} from a
+    # file per queue — always <STUB_QUEUE_FILE>-<name>, regardless of how
+    # many queues are configured, so trimming the list never silently moves
+    # a knob file — the way STUB_UTIL_FILE drives utilization.
+    stub_queue = os.environ.get("STUB_QUEUE_NAME", "")
+    if source_kind == "stub" and stub_queue:
+        queue_names = [n.strip() for n in stub_queue.split(",") if n.strip()]
+        queue_base = os.environ.get("STUB_QUEUE_FILE", "/tmp/stub-queue")
+        queue_default = float(os.environ.get("STUB_QUEUE_DEPTH", "0"))
+        queue_ns = os.environ.get("STUB_QUEUE_NAMESPACE", "default")
+
+        def stub_queue_fn():
+            rows = []
+            for name in queue_names:
+                try:
+                    with open(f"{queue_base}-{name}") as f:
+                        depth = float(f.read().strip())
+                except (OSError, ValueError):
+                    depth = queue_default
+                rows.append((name, queue_ns, f"{name}-stub", depth))
+            return rows
+
+        daemon.queue_fn = stub_queue_fn
     daemon.run_forever()
 
 

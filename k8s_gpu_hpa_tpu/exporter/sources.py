@@ -252,6 +252,19 @@ class MergedLibtpuSource:
         except Exception as e:  # noqa: BLE001 — per-port outcome, never raises
             return e
 
+    def unmapped_advertised(self) -> list[str] | None:
+        """Union of per-port advertised-but-unconsumed names (see
+        LibtpuSource.unmapped_advertised); None when no port has the
+        capability RPC.  Uses already-probed capability sets only — never
+        issues RPCs — so the daemon can call it right after a sweep."""
+        union: set[str] = set()
+        any_known = False
+        for source in self._sources:
+            if source._supported_probed and source._supported is not None:
+                any_known = True
+                union |= source._supported - libtpu_proto.CONSUMED_METRICS
+        return sorted(union) if any_known else None
+
     def close(self) -> None:
         """Like LibtpuSource.close(): the source stays usable — the next
         sample() lazily reconnects channels and recreates the pool."""
@@ -330,6 +343,18 @@ class LibtpuSource:
             self._supported = None
         self._supported_probed = True
         return self._supported
+
+    def unmapped_advertised(self) -> list[str] | None:
+        """Advertised metric names the exporter does not consume, or None
+        when the ListSupportedMetrics RPC is unavailable.  Real-hardware
+        operators should report these (doctor --libtpu prints them): they
+        are how the speculative thermal/power candidate names
+        (libtpu_proto.CHIP_TEMP_CANDIDATES/CHIP_POWER_CANDIDATES) get
+        replaced with the names an actual build serves."""
+        advertised = self.supported_metrics()
+        if advertised is None:
+            return None
+        return sorted(advertised - libtpu_proto.CONSUMED_METRICS)
 
     def close(self) -> None:
         if self._channel is not None:

@@ -234,7 +234,9 @@ def main() -> None:
 
     from k8s_gpu_hpa_tpu.loadgen.knob import IntensityKnob
     from k8s_gpu_hpa_tpu.loadgen.telemetry import TelemetryWriter
+    from k8s_gpu_hpa_tpu.utils.profiling import ProfileWindow
 
+    profile = ProfileWindow()
     gen = DecodeLoadGen(
         batch=int(os.environ.get("DECODE_BATCH", "8")),
         max_seq=int(os.environ.get("MAX_SEQ", "2048")),
@@ -268,6 +270,7 @@ def main() -> None:
     last_report = time.perf_counter()
     last_tick = time.perf_counter()
     while True:
+        profile.poll()
         now = time.perf_counter()
         queue.offer((now - last_tick) * knob.poll() * offered_rps_max)
         last_tick = now

@@ -162,17 +162,22 @@ class MatmulLoadGen:
             def body_op(x, b):
                 return inner(x, b)
 
-        def burst(a, b):
+        def burst(a, b, n):
             # Chain matmuls so one dispatch keeps the MXU busy for the whole
             # burst; normalization keeps values from overflowing bf16.  The
             # return value is a scalar probe: fetching it forces completion
             # even on backends whose block_until_ready does not actually block
             # (remote-tunnel platforms), and transfers 4 bytes, not the matrix.
+            # ``n`` is a TRACED bound (one compile covers every burst length):
+            # step() shortens bursts at low intensity so the duty cycle stays
+            # smooth — a fixed-length burst at intensity 0.05 means a multi-
+            # second cycle whose sliding-window utilization flaps between 0
+            # and 3x the commanded duty, which reads as autoscaler noise.
             def body(_, x):
                 y = body_op(x, b)
                 return y * (1.0 / jnp.sqrt(jnp.float32(self.size)).astype(y.dtype))
 
-            out = lax.fori_loop(0, self.iters_per_burst, body, a)
+            out = lax.fori_loop(0, n, body, a)
             return out.ravel()[0].astype(jnp.float32)
 
         self._burst = jax.jit(burst)
@@ -207,7 +212,9 @@ class MatmulLoadGen:
     # ---- run loop ----------------------------------------------------------
 
     def warmup(self) -> None:
-        float(self._burst(self._a, self._b))  # compile + first run
+        # compile + first run (the traced bound means this one compile also
+        # covers every shorter burst step() will ask for)
+        float(self._burst(self._a, self._b, jnp.int32(self.iters_per_burst)))
         # calibrate the dispatch/readback floor so achieved-FLOPs numbers can
         # exclude it (on a remote-tunnel dev setup it is tens of ms; on a real
         # node it is microseconds)
@@ -222,14 +229,26 @@ class MatmulLoadGen:
 
     def step(self) -> float:
         """One burst + duty-cycle sleep; returns busy seconds."""
-        if self.knob.poll() <= 0.0:
+        intensity = self.knob.poll()
+        if intensity <= 0.0:
             self.knob.throttle(0.0)  # idle-poll, don't spin
             self._record(0.0, 0.0)
             return 0.0
+        # Intensity-scaled burst: keep the busy/idle CYCLE short (about one
+        # full-length burst) so the windowed duty reading is smooth at any
+        # intensity.  A full burst at intensity 0.05 would idle ~19 burst
+        # lengths per cycle — longer than the reporting window, so sampled
+        # utilization would flap 0 <-> 3x commanded instead of reading 5%.
+        n_iters = (
+            self.iters_per_burst
+            if intensity >= 1.0
+            else max(1, round(self.iters_per_burst * intensity))
+        )
         t0 = time.perf_counter()
-        float(self._burst(self._a, self._b))  # scalar fetch forces completion
+        # scalar fetch forces completion
+        float(self._burst(self._a, self._b, jnp.int32(n_iters)))
         busy = time.perf_counter() - t0
-        flops = 2.0 * self.size**3 * self.iters_per_burst * self.n_devices
+        flops = 2.0 * self.size**3 * n_iters * self.n_devices
         self._record(busy, flops)
         self._steps += 1
         self.knob.throttle(busy)  # duty cycle: busy/(busy+idle) = intensity
@@ -259,9 +278,15 @@ class MatmulLoadGen:
         wall = max(time.perf_counter() - t_first, 1e-9)
         # exclude the calibrated dispatch/readback floor from compute-rate
         # accounting (it still counts toward duty-cycle utilization, which is
-        # about load patterns, not kernel efficiency)
-        bursts = sum(1 for _, b, _ in self._history if b > 0)
-        compute = max(busy - bursts * self._rtt, 1e-9)
+        # about load patterns, not kernel efficiency).  Per-burst floor: a
+        # short low-intensity burst can be smaller than the RTT estimate's
+        # jitter, and subtracting the full RTT from it would divide by ~zero
+        # and report an absurd rate — keep at least 10% of each burst's
+        # measured time as compute.
+        compute = max(
+            sum(max(b - self._rtt, 0.1 * b) for _, b, _ in self._history if b > 0),
+            1e-9,
+        )
         return LoadGenStats(
             utilization=min(100.0, 100.0 * busy / wall),
             achieved_tflops=(flops / compute / 1e12) if flops > 0 else 0.0,
@@ -295,7 +320,9 @@ def main() -> None:
     TPU_TEST_INTENSITY_FILE (runtime knob), REPORT_S (stats print period).
     """
     from k8s_gpu_hpa_tpu.loadgen.telemetry import TelemetryWriter
+    from k8s_gpu_hpa_tpu.utils.profiling import ProfileWindow
 
+    profile = ProfileWindow()
     size = int(os.environ.get("MATMUL_SIZE", "4096"))
     report_every = float(os.environ.get("REPORT_S", "10"))
     gen = MatmulLoadGen(size=size)
@@ -311,6 +338,7 @@ def main() -> None:
     )
     last_report = time.perf_counter()
     while True:
+        profile.poll()
         gen.step()
         s = gen.stats()
         # self-report the gauges only the workload can measure: duty cycle

@@ -256,10 +256,15 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _terminate)
     signal.signal(signal.SIGINT, _terminate)
 
+    from k8s_gpu_hpa_tpu.utils.profiling import ProfileWindow
+
+    profile = ProfileWindow()
     last_report = time.perf_counter()
     last_ckpt_step = gen.stats().steps
     while True:
+        profile.poll()
         if stopping:
+            profile.close()
             if manager is not None and gen.stats().steps > last_ckpt_step:
                 gen.save_checkpoint(manager)
                 manager.wait_until_finished()  # flush the async commit

@@ -169,6 +169,8 @@ def workload_deployment(
     topology: str,
     accelerator: str = ACCEL_V5E,
     container_name: str | None = None,
+    node_selector: dict[str, str] | None = None,
+    tolerations: list[dict] | None = None,
 ) -> dict:
     """A TPU workload Deployment (analog of cuda-test-deployment.yaml): the
     ``app: <name>`` label is the pipeline join key, ``spec.replicas`` is
@@ -176,7 +178,12 @@ def workload_deployment(
     intensity-file env gives the runtime load knob that replaces the
     reference's "rerun the busy-loop via exec" trick (README.md:113-116), and
     the telemetry hostPath + Downward-API identity let the workload
-    self-report the gauges device counters can't (loadgen/telemetry.py)."""
+    self-report the gauges device counters can't (loadgen/telemetry.py).
+
+    ``node_selector``/``tolerations`` replace the GKE-provisioned defaults
+    wholesale for clusters without the GKE TPU labels — the analog of the
+    reference's hand-applied ``accelerator=nvidia`` node label
+    (README.md:26-30, dcgm-exporter.yaml:22-23)."""
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -186,11 +193,19 @@ def workload_deployment(
             "template": {
                 "metadata": {"labels": {"app": name}},
                 "spec": {
-                    "nodeSelector": {
-                        NODE_SELECTOR_ACCEL: accelerator,
-                        NODE_SELECTOR_TOPO: topology,
-                    },
-                    "tolerations": tpu_tolerations(),
+                    "nodeSelector": (
+                        dict(node_selector)
+                        if node_selector is not None
+                        else {
+                            NODE_SELECTOR_ACCEL: accelerator,
+                            NODE_SELECTOR_TOPO: topology,
+                        }
+                    ),
+                    "tolerations": (
+                        [dict(t) for t in tolerations]
+                        if tolerations is not None
+                        else tpu_tolerations()
+                    ),
                     "containers": [
                         {
                             "name": container_name or name,
@@ -267,7 +282,12 @@ def loadgen_env(intensity: str = "0.5", matmul_size: str | None = "4096") -> dic
 # L2: the exporter DaemonSet + Service (analog dcgm-exporter.yaml:1-77).
 
 
-def exporter_daemonset(accelerator: str = ACCEL_V5E) -> dict:
+def exporter_daemonset(
+    accelerator: str = ACCEL_V5E,
+    *,
+    node_selector: dict[str, str] | None = None,
+    tolerations: list[dict] | None = None,
+) -> dict:
     labels = {
         "app.kubernetes.io/name": EXPORTER_NAME,
         "app.kubernetes.io/version": VERSION,
@@ -284,8 +304,16 @@ def exporter_daemonset(accelerator: str = ACCEL_V5E) -> dict:
             "template": {
                 "metadata": {"labels": dict(labels)},
                 "spec": {
-                    "nodeSelector": {NODE_SELECTOR_ACCEL: accelerator},
-                    "tolerations": tpu_tolerations(),
+                    "nodeSelector": (
+                        dict(node_selector)
+                        if node_selector is not None
+                        else {NODE_SELECTOR_ACCEL: accelerator}
+                    ),
+                    "tolerations": (
+                        [dict(t) for t in tolerations]
+                        if tolerations is not None
+                        else tpu_tolerations()
+                    ),
                     "hostNetwork": True,
                     "containers": [
                         {
@@ -656,6 +684,8 @@ def multihost_statefulset(
     topology: str = "2x2x2",
     accelerator: str = ACCEL_V5P,
     intensity: str = "0.5",
+    node_selector: dict[str, str] | None = None,
+    tolerations: list[dict] | None = None,
 ) -> dict:
     return {
         "apiVersion": "apps/v1",
@@ -668,11 +698,19 @@ def multihost_statefulset(
             "template": {
                 "metadata": {"labels": {"app": name}},
                 "spec": {
-                    "nodeSelector": {
-                        NODE_SELECTOR_ACCEL: accelerator,
-                        NODE_SELECTOR_TOPO: topology,
-                    },
-                    "tolerations": tpu_tolerations(),
+                    "nodeSelector": (
+                        dict(node_selector)
+                        if node_selector is not None
+                        else {
+                            NODE_SELECTOR_ACCEL: accelerator,
+                            NODE_SELECTOR_TOPO: topology,
+                        }
+                    ),
+                    "tolerations": (
+                        [dict(t) for t in tolerations]
+                        if tolerations is not None
+                        else tpu_tolerations()
+                    ),
                     "containers": [
                         {
                             "name": "tpu-test",
@@ -1054,6 +1092,13 @@ class PipelineSpec:
     #: slices at min/max for the multi-host shape (pods = slices * hosts)
     min_slices: int = 1
     max_slices: int = 4
+    #: non-GKE fallback: replace the GKE-provisioned node labels/taints with
+    #: hand-applied ones (reference README.md:26-30 labels nodes
+    #: ``accelerator=nvidia`` by hand on non-GKE clusters).  Setting
+    #: ``node_selector`` also makes the pipeline carry its own exporter
+    #: DaemonSet, since the bundle's GKE-labeled one would not schedule.
+    node_selector: dict[str, str] | None = None
+    tolerations: list[dict] | None = None
 
     def __post_init__(self) -> None:
         import re
@@ -1115,6 +1160,19 @@ def render_pipeline(spec: PipelineSpec) -> dict[str, list[dict]]:
     Service + StatefulSet-of-slices workload, the rule addressed at the
     StatefulSet, and a slice-quantum HPA (pair it with
     deploy/quantum-operator.yaml on a vanilla cluster)."""
+    # non-GKE clusters (hand-labeled nodes): the pipeline must also carry
+    # the exporter DaemonSet, because the shared bundle's GKE-labeled one
+    # would never schedule there
+    extra: dict[str, list[dict]] = {}
+    if spec.node_selector is not None:
+        extra[f"{spec.app}-exporter-daemonset.yaml"] = [
+            exporter_daemonset(
+                spec.accelerator,
+                node_selector=spec.node_selector,
+                tolerations=spec.tolerations,
+            ),
+            exporter_service(),
+        ]
     if spec.multihost:
         q = spec.hosts_per_slice
         return {
@@ -1127,8 +1185,11 @@ def render_pipeline(spec: PipelineSpec) -> dict[str, list[dict]]:
                     topology=spec.topology,
                     accelerator=spec.accelerator,
                     intensity=spec.intensity,
+                    node_selector=spec.node_selector,
+                    tolerations=spec.tolerations,
                 ),
             ],
+            **extra,
             f"{spec.app}-prometheusrule.yaml": [
                 prometheusrule_manifest(
                     spec.app, groups=[(spec.app, [spec.recording_rule()])]
@@ -1184,8 +1245,11 @@ def render_pipeline(spec: PipelineSpec) -> dict[str, list[dict]]:
                 tpu_limit=spec.tpu_limit,
                 topology=spec.topology,
                 accelerator=spec.accelerator,
+                node_selector=spec.node_selector,
+                tolerations=spec.tolerations,
             )
         ],
+        **extra,
         f"{spec.app}-prometheusrule.yaml": [
             prometheusrule_manifest(
                 spec.app, groups=[(spec.app, [spec.recording_rule()])]

@@ -1,0 +1,75 @@
+"""Env-gated profiling window: one ``jax.profiler`` trace per process.
+
+The reference stack has no profiling story at all (SURVEY.md §5: Grafana is
+deployed unconfigured, nothing captures device timelines).  On TPU the
+profiler is the tool that actually explains a utilization number — the trace
+shows MXU occupancy, HBM stalls, and XLA fusion boundaries behind the gauges
+the exporter serves.
+
+Contract: set ``PROFILE_S=10`` on any load-generator container and the
+process captures ONE 10-second trace starting at its next main-loop tick,
+written under ``PROFILE_DIR`` (default ``/tmp/tpu-profile``).  The window is
+polled from the generator's own loop rather than a timer thread so the trace
+brackets exactly the steady-state work the loop does — no thread-injected
+gap, and stop_trace runs on the same thread that started it.
+
+Fetch from a pod:  kubectl cp <pod>:/tmp/tpu-profile ./trace  (then
+``tensorboard --logdir ./trace`` or xprof; README "Profiling a workload").
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class ProfileWindow:
+    """One-shot trace window driven by ``poll()`` calls from a main loop.
+
+    Disabled (every call a no-op) unless ``PROFILE_S`` parses to a positive
+    number of seconds.  The first ``poll()`` starts the trace; the first
+    ``poll()`` at least ``PROFILE_S`` seconds later stops it.  A second
+    window never opens: one process, one trace, so the artifact a runbook
+    step fetches is unambiguous.
+    """
+
+    def __init__(self, env: dict | None = None):
+        env = os.environ if env is None else env
+        try:
+            self.seconds = float(env.get("PROFILE_S", "0") or "0")
+        except ValueError:
+            self.seconds = 0.0
+        self.dir = env.get("PROFILE_DIR", "/tmp/tpu-profile")
+        self._started_at: float | None = None
+        self._done = self.seconds <= 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.seconds > 0
+
+    def poll(self) -> None:
+        if self._done:
+            return
+        import jax
+
+        now = time.perf_counter()
+        if self._started_at is None:
+            jax.profiler.start_trace(self.dir)
+            self._started_at = now
+            print(
+                f"profiling: capturing {self.seconds:.0f}s trace to {self.dir}",
+                flush=True,
+            )
+        elif now - self._started_at >= self.seconds:
+            jax.profiler.stop_trace()
+            self._done = True
+            print(f"profiling: trace written to {self.dir}", flush=True)
+
+    def close(self) -> None:
+        """Stop an open window early (shutdown path) so a SIGTERM mid-window
+        still leaves a readable trace on disk."""
+        if self._started_at is not None and not self._done:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._done = True

@@ -66,3 +66,46 @@ def test_gen_manifests_writes_loadable_files(tmp_path):
     assert len(files) == 18
     for f in files:
         assert list(yaml.safe_load_all(f.read_text()))
+
+
+def test_gen_pipeline_node_selector_and_toleration_flags(tmp_path, capsys):
+    rc = main(
+        [
+            "gen-pipeline",
+            "--app", "byoc",
+            "--node-selector", "accelerator=tpu",
+            "--node-selector", "pool=tpu-vms",
+            "--toleration", "dedicated=tpu:NoSchedule",
+            "--toleration", "tpu:NoExecute",
+            "-o", str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    dep = yaml.safe_load((tmp_path / "byoc-deployment.yaml").read_text())
+    pod_spec = dep["spec"]["template"]["spec"]
+    assert pod_spec["nodeSelector"] == {"accelerator": "tpu", "pool": "tpu-vms"}
+    assert pod_spec["tolerations"] == [
+        {"key": "dedicated", "operator": "Equal", "value": "tpu", "effect": "NoSchedule"},
+        {"key": "tpu", "operator": "Exists", "effect": "NoExecute"},
+    ]
+    # the pipeline carries its own exporter DaemonSet for the labeled nodes
+    ds_docs = list(
+        yaml.safe_load_all((tmp_path / "byoc-exporter-daemonset.yaml").read_text())
+    )
+    assert ds_docs[0]["kind"] == "DaemonSet"
+    assert ds_docs[0]["spec"]["template"]["spec"]["nodeSelector"] == {
+        "accelerator": "tpu",
+        "pool": "tpu-vms",
+    }
+
+
+def test_gen_pipeline_rejects_malformed_node_selector(capsys):
+    rc = main(["gen-pipeline", "--app", "x", "--node-selector", "nokey"])
+    assert rc == 2
+    assert "KEY=VALUE" in capsys.readouterr().err
+
+
+def test_gen_pipeline_rejects_malformed_toleration(capsys):
+    rc = main(["gen-pipeline", "--app", "x", "--toleration", "noeffect"])
+    assert rc == 2
+    assert "EFFECT" in capsys.readouterr().err

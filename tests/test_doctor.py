@@ -213,3 +213,26 @@ def test_alerts_probe_reports_firing_tpu_alerts():
 
     results = diagnose(alerts_fetch=lambda: firing)
     assert results[-1].name == "alerts" and not results[-1].ok
+
+
+def test_probe_libtpu_flags_unmapped_advertised_names(capsys):
+    """doctor --libtpu marks advertised-but-unconsumed names so real-hardware
+    operators can report the actual thermal/power spellings (VERDICT r2 #9)."""
+    from k8s_gpu_hpa_tpu.doctor import probe_libtpu
+    from k8s_gpu_hpa_tpu.exporter import libtpu_proto
+    from k8s_gpu_hpa_tpu.exporter.stub_libtpu import StubLibtpuServer
+
+    advertised = [
+        libtpu_proto.DUTY_CYCLE,
+        libtpu_proto.HBM_USAGE,
+        libtpu_proto.HBM_TOTAL,
+        "tpu.runtime.thermal.die.celsius",
+    ]
+    with StubLibtpuServer(num_chips=1, supported_metrics=advertised) as server:
+        rc = probe_libtpu(server.address)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpu.runtime.thermal.die.celsius  <- unmapped" in out
+    assert "does not consume" in out
+    # mapped names are not flagged
+    assert f"{libtpu_proto.DUTY_CYCLE}  <- unmapped" not in out

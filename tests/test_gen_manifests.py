@@ -210,3 +210,102 @@ def test_multihost_pipeline_cli(tmp_path):
     assert (tmp_path / "llm-serve-statefulset.yaml").exists()
     hpa = yaml.safe_load((tmp_path / "llm-serve-hpa.yaml").read_text())
     assert hpa["spec"]["maxReplicas"] == 4  # 2 slices x 2 hosts
+
+
+def test_node_selector_override_replaces_gke_labels_everywhere():
+    """Non-GKE fallback: a hand-applied node label (the reference's
+    README.md:26-30 ``accelerator=nvidia`` analog) replaces the GKE TPU
+    selector wholesale on the workload AND on the exporter DaemonSet the
+    pipeline now carries (the bundle's GKE-labeled one would not schedule)."""
+    spec = manifests.PipelineSpec(
+        app="byoc-app",
+        node_selector={"accelerator": "tpu"},
+        tolerations=[{"key": "tpu", "operator": "Exists", "effect": "NoSchedule"}],
+    )
+    files = manifests.render_pipeline(spec)
+    assert "byoc-app-exporter-daemonset.yaml" in files
+
+    dep_spec = files["byoc-app-deployment.yaml"][0]["spec"]["template"]["spec"]
+    ds_spec = files["byoc-app-exporter-daemonset.yaml"][0]["spec"]["template"]["spec"]
+    for pod_spec in (dep_spec, ds_spec):
+        assert pod_spec["nodeSelector"] == {"accelerator": "tpu"}
+        assert manifests.NODE_SELECTOR_ACCEL not in pod_spec["nodeSelector"]
+        assert pod_spec["tolerations"] == [
+            {"key": "tpu", "operator": "Exists", "effect": "NoSchedule"}
+        ]
+
+
+def test_node_selector_override_multihost_statefulset():
+    spec = manifests.PipelineSpec(
+        app="byoc-mh",
+        hosts_per_slice=2,
+        node_selector={"accelerator": "tpu", "rack": "a1"},
+    )
+    files = manifests.render_pipeline(spec)
+    _, sts = files["byoc-mh-statefulset.yaml"]
+    pod_spec = sts["spec"]["template"]["spec"]
+    assert pod_spec["nodeSelector"] == {"accelerator": "tpu", "rack": "a1"}
+    # tolerations not overridden -> the default TPU taint toleration stays
+    assert pod_spec["tolerations"] == manifests.tpu_tolerations()
+    assert "byoc-mh-exporter-daemonset.yaml" in files
+
+
+def test_default_pipeline_has_no_exporter_daemonset():
+    files = manifests.render_pipeline(manifests.PipelineSpec(app="gke-app"))
+    assert not any("exporter-daemonset" in name for name in files)
+    pod_spec = files["gke-app-deployment.yaml"][0]["spec"]["template"]["spec"]
+    assert manifests.NODE_SELECTOR_ACCEL in pod_spec["nodeSelector"]
+
+
+def test_non_gke_pipeline_closes_loop_in_simulator():
+    """The VERDICT's done-criterion: a pipeline rendered for hand-labeled
+    ``accelerator=tpu`` nodes still passes the closed-loop contract — the
+    scheduling override must not perturb any string the loop joins on."""
+    spec = manifests.PipelineSpec(
+        app="byoc-loop", target="40", max_replicas=3,
+        node_selector={"accelerator": "tpu"},
+    )
+    files = manifests.render_pipeline(spec)
+    hpa_doc = files["byoc-loop-hpa.yaml"][0]
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+
+    class Target:
+        replicas = 1
+
+        def scale_to(self, n):
+            self.replicas = n
+
+    target = Target()
+    adapter = CustomMetricsAdapter(db, [AdapterRule(series=spec.record)])
+    hpa = HPAController(
+        target=target,
+        metrics=metrics_from_manifest(hpa_doc),
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+    evaluator = RuleEvaluator(db, [spec.recording_rule()])
+    for step in range(40):
+        now = clock.now()
+        for pod in [f"byoc-loop-{i}" for i in range(target.replicas)]:
+            db.append(
+                spec.device_metric,
+                (("chip", "0"), ("namespace", "default"), ("node", "n0"), ("pod", pod)),
+                95.0,
+                now,
+            )
+            db.append(
+                "kube_pod_labels",
+                (("label_app", "byoc-loop"), ("namespace", "default"), ("pod", pod)),
+                1.0,
+                now,
+            )
+        evaluator.evaluate_once()
+        if step % 15 == 14:
+            hpa.sync_once()
+        clock.advance(1.0)
+    assert target.replicas == 3

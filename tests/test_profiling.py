@@ -1,0 +1,63 @@
+"""The env-gated profiling window (utils/profiling.py).
+
+SURVEY.md §5 marks tracing/profiling as the reference's empty slot (Grafana
+deployed unconfigured, no device timeline anywhere).  These tests prove the
+PROFILE_S contract end to end on the CPU backend: a window opens, brackets
+real JAX work, and leaves a fetchable xplane trace artifact on disk.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_hpa_tpu.utils.profiling import ProfileWindow
+
+
+def _trace_files(root):
+    return [p for p in root.rglob("*.xplane.pb")]
+
+
+def test_disabled_by_default(tmp_path):
+    w = ProfileWindow(env={})
+    assert not w.enabled
+    for _ in range(3):
+        w.poll()  # must be a free no-op
+    w.close()
+    assert _trace_files(tmp_path) == []
+
+
+def test_malformed_profile_s_disables(tmp_path):
+    w = ProfileWindow(env={"PROFILE_S": "ten", "PROFILE_DIR": str(tmp_path)})
+    assert not w.enabled
+    w.poll()
+    assert _trace_files(tmp_path) == []
+
+
+def test_window_captures_one_trace(tmp_path):
+    w = ProfileWindow(env={"PROFILE_S": "0.2", "PROFILE_DIR": str(tmp_path)})
+    assert w.enabled
+    x = jnp.ones((64, 64))
+    deadline = time.perf_counter() + 10.0
+    while not w._done and time.perf_counter() < deadline:
+        w.poll()
+        x = (x @ x).block_until_ready()
+        time.sleep(0.02)
+    assert w._done, "window never closed"
+    files = _trace_files(tmp_path)
+    assert files, "no xplane trace artifact written"
+    # one process, one trace: further polls must not open a second window
+    before = len(files)
+    for _ in range(5):
+        w.poll()
+    assert len(_trace_files(tmp_path)) == before
+
+
+def test_close_flushes_open_window(tmp_path):
+    w = ProfileWindow(env={"PROFILE_S": "60", "PROFILE_DIR": str(tmp_path)})
+    w.poll()  # opens the 60 s window
+    (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+    w.close()  # SIGTERM path: stop early, keep the artifact
+    assert _trace_files(tmp_path)
+    w.poll()  # no reopen after close
+    assert w._done

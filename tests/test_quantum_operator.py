@@ -673,3 +673,65 @@ def test_shipped_manifest_has_probes_and_lease_rbac():
     assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
     env = {e["name"] for e in container["env"]}
     assert {"POD_NAME", "HEALTH_PORT"} <= env
+
+
+# ---- the kind-e2e leg 10 scenario, in-process -----------------------------
+
+
+def test_kind_e2e_leg10_scenario_from_shipped_manifest(kube):
+    """In-process mirror of tools/kind-e2e.sh leg 10, driven by the SHIPPED
+    harness manifest (deploy/kind-e2e/fake-multihost.yaml): queue depth 600
+    at AverageValue 100 makes the vanilla HPA want 6, its deliberately odd
+    Pods-3 step lands on 5 (partial slice), and the operator rounds 5 -> 6
+    with exactly ONE patch — the same trajectory the kind leg asserts on a
+    real apiserver (this environment cannot run kind; see README)."""
+    import math
+
+    import yaml as _yaml
+    from pathlib import Path
+
+    docs = list(
+        _yaml.safe_load_all(
+            (
+                Path(__file__).parent.parent / "deploy/kind-e2e/fake-multihost.yaml"
+            ).read_text()
+        )
+    )
+    manifest_hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+    q = int(manifest_hpa["metadata"]["annotations"][QUANTUM_ANNOTATION])
+    up_policy = manifest_hpa["spec"]["behavior"]["scaleUp"]["policies"][0]
+    assert up_policy["type"] == "Pods" and up_policy["value"] % q != 0, (
+        "the harness HPA must step by a non-multiple or the partial state "
+        "the operator exists for never appears"
+    )
+    external = manifest_hpa["spec"]["metrics"][0]["external"]
+    average_value = float(external["target"]["averageValue"])
+
+    start = int(sts["spec"]["replicas"])
+    depth = 600.0
+    want = math.ceil(depth / average_value)  # 6, the e2e leg's end state
+    assert want % q == 0
+
+    kube.hpas = [
+        {
+            "metadata": manifest_hpa["metadata"],
+            "spec": manifest_hpa["spec"],
+            "status": {"desiredReplicas": start},
+        }
+    ]
+    kube.scales[KEY] = start
+    op = QuantumOperator(KubeClient(api_base=kube.base, token="t"))
+
+    # vanilla HPA sync 1: policy-capped step toward 6 lands on the partial 5
+    vanilla_hpa_sync(kube, min(start + up_policy["value"], want))
+    kube.hpas[0]["status"]["desiredReplicas"] = want  # status carries intent
+    assert kube.scales[KEY] == 5
+    op.reconcile_once()  # operator's 5s tick inside the HPA's 15s window
+    assert kube.scales[KEY] == want
+    # vanilla HPA sync 2 agrees (current == desired); nobody moves again
+    vanilla_hpa_sync(kube, want)
+    for _ in range(4):
+        op.reconcile_once()
+    assert kube.scales[KEY] == want
+    assert kube.patches == [(KEY, want)], "exactly one operator patch"

@@ -406,3 +406,32 @@ def test_serve_rung_closed_loop_on_selfreported_bw(tmp_path):
     assert db.latest(record, {"deployment": "tpu-serve"}) == 85.0
     # ceil(1 * 85/60) = 2 — the rung scales on a signal round 1 pinned to 0
     assert target.replicas >= 2, (target.replicas, hpa.status)
+
+
+def test_daemon_queue_fn_hook_serves_queue_gauges():
+    """The stub queue knob (kind-e2e legs 9-10): a daemon-level queue_fn
+    producer paints tpu_test_queue_depth without any self-report plumbing —
+    the file-knob analog of STUB_UTIL for the External rung."""
+    from k8s_gpu_hpa_tpu.exporter.sources import StubSource
+
+    with ExporterDaemon(
+        StubSource(num_chips=1),
+        node_name="n0",
+        listen_addr="127.0.0.1",
+        port=0,
+    ) as daemon:
+        daemon.queue_fn = lambda: [
+            ("tpu-serve", "default", "tpu-serve-stub", 450.0),
+            ("tpu-test-multihost", "default", "tpu-test-multihost-stub", 600.0),
+        ]
+        daemon.step()
+        body = _fetch(daemon.port)
+    fams = {f.name: f for f in parse_text(body)}
+    rows = {
+        (s.label("queue"), s.label("pod")): s.value
+        for s in fams["tpu_test_queue_depth"].samples
+    }
+    assert rows == {
+        ("tpu-serve", "tpu-serve-stub"): 450.0,
+        ("tpu-test-multihost", "tpu-test-multihost-stub"): 600.0,
+    }

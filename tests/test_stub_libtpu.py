@@ -507,3 +507,77 @@ def test_merged_source_usable_after_close():
             assert len(source.sample()) == 2  # pool + channels recreated
         finally:
             source.close()
+
+
+def test_unmapped_advertised_surfaced():
+    """Advertised-but-unconsumed names are field intelligence (VERDICT r2 #9):
+    a build advertising e.g. its real thermal name under a spelling the
+    candidates miss must be SURFACED, not silently ignored."""
+    from k8s_gpu_hpa_tpu.exporter import libtpu_proto
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    advertised = [
+        LIBTPU_DUTY_CYCLE,
+        LIBTPU_HBM_USAGE,
+        LIBTPU_HBM_TOTAL,
+        "tpu.runtime.thermal.die.celsius",  # not among the candidates
+        "tpu.runtime.uptime.seconds",
+    ]
+    with StubLibtpuServer(num_chips=1, supported_metrics=advertised) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            assert source.unmapped_advertised() == [
+                "tpu.runtime.thermal.die.celsius",
+                "tpu.runtime.uptime.seconds",
+            ]
+        finally:
+            source.close()
+        merged = MergedLibtpuSource(addresses=[server.address])
+        try:
+            # before any sweep: capability sets unprobed, nothing to report
+            assert merged.unmapped_advertised() is None
+            merged.sample()
+            assert merged.unmapped_advertised() == [
+                "tpu.runtime.thermal.die.celsius",
+                "tpu.runtime.uptime.seconds",
+            ]
+        finally:
+            merged.close()
+
+
+def test_unmapped_advertised_none_without_capability_rpc():
+    with StubLibtpuServer(num_chips=1, list_supported_enabled=False) as server:
+        source = LibtpuSource(address=server.address)
+        try:
+            assert source.unmapped_advertised() is None
+        finally:
+            source.close()
+
+
+def test_daemon_logs_unmapped_once(capsys):
+    """The daemon's first good sweep prints advertised-but-unconsumed names
+    exactly once, so an on-node operator sees them in `kubectl logs`."""
+    from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
+    from k8s_gpu_hpa_tpu.exporter.sources import MergedLibtpuSource
+
+    advertised = [
+        LIBTPU_DUTY_CYCLE,
+        LIBTPU_HBM_USAGE,
+        LIBTPU_HBM_TOTAL,
+        "tpu.runtime.mystery.gauge",
+    ]
+    with StubLibtpuServer(num_chips=1, supported_metrics=advertised) as server:
+        daemon = ExporterDaemon(
+            MergedLibtpuSource(addresses=[server.address]),
+            node_name="n0",
+            listen_addr="127.0.0.1",
+            port=0,
+        )
+        try:
+            daemon.step()
+            daemon.step()
+            out = capsys.readouterr().out
+            assert out.count("tpu.runtime.mystery.gauge") == 1
+            assert "does not consume" in out
+        finally:
+            daemon.close()

@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # End-to-end integration of the whole L2→L5 pipeline on a kind cluster with
 # ZERO TPUs: stub exporter (same /metrics contract) + fake workload + the
-# SHIPPED Prometheus values, recording rules, adapter rules, and HPA.
+# SHIPPED Prometheus values, recording rules, adapter rules, and HPA — plus
+# the queue/External rung (stub queue gauges → adapter external API → HPA)
+# and the quantum operator (partial-slice round-up against a live apiserver).
 # This is the harness SURVEY.md §4 calls for ("integration-test the L3→L4→L5
 # loop without TPUs") — the reference has no equivalent.
 #
-# Requires: kind, kubectl, helm, docker, jq.  Takes ~6 minutes.
+# Requires: kind, kubectl, helm, docker, jq.  Takes ~8 minutes.
 # Usage: tools/kind-e2e.sh [--keep]    (--keep leaves the cluster running)
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -15,21 +17,21 @@ KEEP=${1:-}
 
 say() { printf '\n== %s\n' "$*"; }
 
-say "1/8 kind cluster"
+say "1/10 kind cluster"
 kind get clusters 2>/dev/null | grep -qx "$CLUSTER" || kind create cluster --name "$CLUSTER" --wait 120s
 kubectl config use-context "kind-$CLUSTER"
 
-say "2/8 build + load the exporter image"
+say "2/10 build + load the exporter image"
 docker build -q -f docker/Dockerfile.exporter -t ghcr.io/k8s-tpu-hpa/tpu-metrics-exporter:0.1.0 .
 kind load docker-image --name "$CLUSTER" ghcr.io/k8s-tpu-hpa/tpu-metrics-exporter:0.1.0
 
-say "3/8 kube-prometheus-stack (shipped values: 1s tpu-metrics scrape job)"
+say "3/10 kube-prometheus-stack (shipped values: 1s tpu-metrics scrape job)"
 helm repo add prometheus-community https://prometheus-community.github.io/helm-charts >/dev/null
 helm repo update >/dev/null
 helm upgrade --install kube-prometheus-stack prometheus-community/kube-prometheus-stack \
   -f deploy/kube-prometheus-stack-values.yaml --wait --timeout 5m
 
-say "4/8 workload + stub exporter (probe: exporter serves attributed chips)"
+say "4/10 workload + stub exporter (probe: exporter serves attributed chips)"
 kubectl apply -f deploy/kind-e2e/fake-workload.yaml
 kubectl apply -f deploy/kind-e2e/stub-exporter.yaml
 kubectl rollout status deploy/tpu-test deploy/tpu-metrics-exporter --timeout 120s
@@ -39,7 +41,7 @@ curl -fsS localhost:19400/metrics | grep -q 'tpu_tensorcore_utilization{.*pod="t
   || { echo "FAIL: exporter not attributing chips to workload pods"; exit 1; }
 kill $PF1
 
-say "5/8 recording rules (probe: recorded series appears)"
+say "5/10 recording rules (probe: recorded series appears)"
 kubectl apply -f deploy/tpu-test-prometheusrule.yaml
 kubectl port-forward svc/kube-prometheus-stack-prometheus 19090:9090 >/dev/null 2>&1 &
 PF2=$!; sleep 2
@@ -50,7 +52,7 @@ done
 [ -n "${V:-}" ] || { echo "FAIL: tpu_test_tensorcore_avg never recorded"; exit 1; }
 echo "   tpu_test_tensorcore_avg=$V"
 
-say "6/8 prometheus-adapter (probe: metric on custom.metrics.k8s.io)"
+say "6/10 prometheus-adapter (probe: metric on custom.metrics.k8s.io)"
 helm upgrade --install prometheus-adapter prometheus-community/prometheus-adapter \
   -f deploy/prometheus-adapter-values.yaml --wait --timeout 3m
 for i in $(seq 1 30); do
@@ -60,7 +62,7 @@ done
 kubectl get --raw /apis/custom.metrics.k8s.io/v1beta1 | jq -r . | grep -q tpu_test_tensorcore_avg \
   || { echo "FAIL: adapter does not serve tpu_test_tensorcore_avg"; exit 1; }
 
-say "7/8 HPA + induced load (the closed-loop test: 1 -> 4 replicas)"
+say "7/10 HPA + induced load (the closed-loop test: 1 -> 4 replicas)"
 kubectl apply -f deploy/tpu-test-hpa.yaml
 EXPORTER_POD=$(kubectl get pod -l app.kubernetes.io/name=tpu-metrics-exporter -o jsonpath='{.items[0].metadata.name}')
 kubectl exec "$EXPORTER_POD" -- sh -c 'echo 90 > /tmp/stub-util'
@@ -73,9 +75,56 @@ done
 [ "${READY:-0}" -ge 4 ] || { echo "FAIL: scale-up did not reach 4 replicas"; kubectl describe hpa tpu-test; exit 1; }
 echo "   scaled to $READY replicas"
 
-say "8/8 scale-down path (drop the knob; stabilization window applies)"
+say "8/10 scale-down path (drop the knob; stabilization window applies)"
 kubectl exec "$EXPORTER_POD" -- sh -c 'echo 10 > /tmp/stub-util'
 echo "   replicas will decay after the 120s stabilization window (not awaited)"
+
+say "9/10 queue/External rung (stub queue gauges -> external API -> HPA)"
+kubectl apply -f deploy/kind-e2e/fake-serve.yaml
+kubectl apply -f deploy/tpu-test-external-hpa.yaml
+kubectl rollout status deploy/tpu-serve --timeout 120s
+kubectl exec "$EXPORTER_POD" -- sh -c 'echo 450 > /tmp/stub-queue-tpu-serve'
+# probe: the series reaches external.metrics.k8s.io with the queue selector
+for i in $(seq 1 30); do
+  QV=$(kubectl get --raw "/apis/external.metrics.k8s.io/v1beta1/namespaces/default/tpu_test_queue_depth?labelSelector=queue%3Dtpu-serve" 2>/dev/null \
+    | jq -r '.items[0].value // empty')
+  [ -n "$QV" ] && [ "$QV" != "0" ] && break; sleep 2
+done
+{ [ -n "${QV:-}" ] && [ "${QV:-0}" != "0" ]; } || { echo "FAIL: external API never served a nonzero tpu_test_queue_depth"; exit 1; }
+echo "   external tpu_test_queue_depth{queue=tpu-serve}=$QV"
+# probe: AverageValue 100 on depth 450 -> ceil(450/100)=5, capped at max 4
+DEADLINE=$(( $(date +%s) + 180 ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  SREADY=$(kubectl get deploy tpu-serve -o jsonpath='{.status.readyReplicas}')
+  [ "${SREADY:-0}" -ge 4 ] && break
+  sleep 5
+done
+[ "${SREADY:-0}" -ge 4 ] || { echo "FAIL: External rung did not scale tpu-serve to 4"; kubectl describe hpa tpu-serve-queue; exit 1; }
+echo "   queue depth scaled tpu-serve to $SREADY replicas"
+kubectl exec "$EXPORTER_POD" -- sh -c 'echo 10 > /tmp/stub-queue-tpu-serve'
+
+say "10/10 quantum operator (partial-slice round-up on a live apiserver)"
+kubectl apply -f deploy/kind-e2e/fake-multihost.yaml
+kubectl apply -f deploy/quantum-operator.yaml
+# readiness gates on /readyz, which requires HOLDING the leader Lease: a
+# completed rollout proves election against the real coordination API
+kubectl rollout status deploy/quantum-operator --timeout 120s
+kubectl rollout status sts/tpu-test-multihost --timeout 120s
+kubectl exec "$EXPORTER_POD" -- sh -c 'echo 600 > /tmp/stub-queue-tpu-test-multihost'
+# depth 600 / AverageValue 100 -> HPA wants 6; its odd Pods-3 step lands on
+# 5 (partial slice); the operator's 5s tick rounds 5->6 inside the HPA's
+# 15s sync window
+DEADLINE=$(( $(date +%s) + 240 ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  MREPL=$(kubectl get sts tpu-test-multihost -o jsonpath='{.status.readyReplicas}')
+  [ "${MREPL:-0}" -ge 6 ] && break
+  sleep 5
+done
+[ "${MREPL:-0}" -ge 6 ] || { echo "FAIL: multihost rung never reached 6 replicas"; kubectl describe hpa tpu-test-multihost; exit 1; }
+kubectl logs deploy/quantum-operator | grep -q 'repaired StatefulSet/tpu-test-multihost' \
+  || { echo "FAIL: operator log shows no partial-slice repair"; kubectl logs deploy/quantum-operator; exit 1; }
+echo "   operator repaired the partial slice:"
+kubectl logs deploy/quantum-operator | grep 'repaired StatefulSet/tpu-test-multihost' | tail -1
 
 kill $PF2 2>/dev/null || true
 say "E2E OK"
