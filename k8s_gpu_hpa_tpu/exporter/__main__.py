@@ -2,4 +2,5 @@
 
 from k8s_gpu_hpa_tpu.exporter.daemon import main
 
-main()
+if __name__ == "__main__":
+    main()

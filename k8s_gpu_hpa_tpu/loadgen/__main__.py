@@ -10,4 +10,5 @@ if os.environ.get("WORKLOAD", "matmul") == "decode":
 else:
     from k8s_gpu_hpa_tpu.loadgen.matmul import main
 
-main()
+if __name__ == "__main__":
+    main()

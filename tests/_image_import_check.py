@@ -1,0 +1,73 @@
+"""Subprocess runner for the image-deps contract test (test_image_deps.py).
+
+Simulates a container image's Python environment: replaces the path-based
+module finder with a gated one on which any third-party top-level module
+outside the image's declared dependency closure simply does not exist — a
+plain ``import`` of it raises the same ``ModuleNotFoundError`` the kubelet
+would see, and availability probes (``importlib.util.find_spec``, ``try:
+import`` for optional deps) degrade exactly as they would in the container.
+Then imports the manifest's ``python -m <module>`` entry chain; the modules
+land in ``sys.modules`` under their dotted names, so every module-level
+import executes while ``if __name__ == "__main__"`` keeps the workload loop
+from starting.
+
+Usage: python _image_import_check.py <module> <allowed_root,allowed_root,...>
+
+Exit 0: all import-time dependencies are declared.  Exit 1 with the missing
+module on stdout: the container would CrashLoopBackOff at import — the
+silent joint-breakage class VERDICT.md round-3 weak #1 describes.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.machinery as machinery
+import sys
+import traceback
+
+
+class _GatedPathFinder:
+    """PathFinder that cannot see undeclared third-party modules."""
+
+    def __init__(self, allowed_roots: set[str]):
+        self.allowed_roots = allowed_roots
+
+    def _visible(self, fullname: str) -> bool:
+        top = fullname.split(".", 1)[0]
+        return (
+            top in sys.stdlib_module_names
+            or top in self.allowed_roots
+            # platform stdlib module missing from stdlib_module_names
+            or top.startswith("_sysconfigdata")
+        )
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not self._visible(fullname):
+            return None  # not installed in this image
+        return machinery.PathFinder.find_spec(fullname, path, target)
+
+
+def main() -> int:
+    module = sys.argv[1]
+    allowed = set(filter(None, sys.argv[2].split(",")))
+    sys.meta_path = [
+        _GatedPathFinder(allowed)
+        if getattr(f, "__name__", type(f).__name__) == "PathFinder"
+        else f
+        for f in sys.meta_path
+    ]
+    importlib.invalidate_caches()
+    try:
+        mod = importlib.import_module(module)
+        if hasattr(mod, "__path__"):
+            # `python -m pkg` executes pkg/__init__.py then pkg/__main__.py
+            importlib.import_module(module + ".__main__")
+    except ModuleNotFoundError as e:
+        print(f"MISSING {e.name}: {e}")
+        traceback.print_exc()
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
