@@ -42,9 +42,26 @@ where the time goes and what the pipeline does beyond the headline:
   need.  This measures the metric-lag overshoot defect the reference
   narrates but never quantifies (README.md:123); the behavior stanza +
   1 s-fresh metrics should hold it at 0.
-- achieved_tflops (busy-time rate, capped at device peak so an RTT
-  mis-estimate cannot report >100 % of the chip), sustained_tflops
-  (wall-time rate), peak_tflops.
+- scale_down_budget: the declared target (BASELINE.md: p50 <= 270 s at 0
+  flaps, the configured 120 s window + two 50%/60s ramp periods + sync
+  slack); a regression fails the bench (nonzero exit after the JSON).
+- kernel: dwell-measured TFLOP/s — ONE long uninterrupted on-device chain
+  of matmuls, wall-clock timed, no RTT correction and no clamp, so
+  achieved < peak by construction (mfu_pct is the honest MFU) — plus the
+  same dwell through the Pallas kernel (the measured XLA-vs-Pallas gap).
+- rungs: one measured result per BASELINE.json config.  Configs 1 (the
+  headline), 2 (v5e-8 HBM Pods metric — REAL device allocations walk the
+  per-pod hottest-chip HBM gauge across the 13Gi target) and 3 (ResNet-50
+  training pod, multi-metric HPA — real training steps on the chip drive
+  the duty-cycle gauge; the bw gauge is honestly absent here, exercising
+  v2's available-metrics max semantics) run against the real chip.
+  Configs 0 (CPU Resource rung) and 4 (multi-host slice-quantum rung) and
+  the External queue rung run in virtual time against the shipped
+  manifests — same controllers, same rules, simulated pod lifecycle.
+- pod_start_sensitivity: virtual-time sweep of POD_START_LATENCY over
+  {12, 30, 60} s — at which pod-start latency the 60 s budget fails, and
+  whether the behavior stanza still holds overshoot at 0 at 60 s lag (the
+  actionable version of the reference's overshoot caveat, README.md:123).
 """
 
 from __future__ import annotations
@@ -52,6 +69,7 @@ from __future__ import annotations
 import json
 import statistics
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -59,27 +77,49 @@ import yaml
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from k8s_gpu_hpa_tpu.control.adapter import AdapterRule, CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.control.adapter import (
+    AdapterRule,
+    CustomMetricsAdapter,
+    ExternalRule,
+    ObjectReference,
+)
 from k8s_gpu_hpa_tpu.control.hpa import (
     HPAController,
     ObjectMetricSpec,
+    ResourceMetricSpec,
     behavior_from_manifest,
+    metrics_from_manifest,
 )
 from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
 from k8s_gpu_hpa_tpu.exporter.podresources import StaticAttributor
 from k8s_gpu_hpa_tpu.exporter.sources import JaxDeviceSource
 from k8s_gpu_hpa_tpu.loadgen.matmul import MatmulLoadGen
 from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
-from k8s_gpu_hpa_tpu.metrics.rules import RuleEvaluator, tpu_test_avg_rule
-from k8s_gpu_hpa_tpu.metrics.schema import ChipSample, MetricFamily, families_from_chips
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    RuleEvaluator,
+    tpu_test_avg_rule,
+    tpu_test_pod_max_rule,
+)
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    TPU_DUTY_CYCLE,
+    ChipSample,
+    MetricFamily,
+    families_from_chips,
+)
 from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
-from k8s_gpu_hpa_tpu.utils.clock import SystemClock
+from k8s_gpu_hpa_tpu.utils.clock import SystemClock, VirtualClock
 
 TARGET = 40.0
 MAX_REPLICAS = 4
 POD_START_LATENCY = 12.0
 HPA_SYNC = 15.0
 BUDGET_S = 60.0
+#: declared scale-down budget (BASELINE.md): the configured 120 s
+#: stabilization window + two 50%/60s ramp periods (4->2->1) + sync slack.
+SCALE_DOWN_BUDGET_S = 270.0
+SCALE_DOWN_MAX_FLAPS = 0
+DEPLOY = Path(__file__).resolve().parent / "deploy"
+GIB = 1 << 30
 
 
 class MirrorDeployment:
@@ -105,6 +145,10 @@ class MirrorDeployment:
     def running(self) -> list[str]:
         now = self.clock.now()
         return [p for p, ready in self.pods.items() if ready <= now]
+
+    def ready_pod_names(self) -> list[str]:
+        """PodLister contract for Pods-type metrics (control/hpa.py)."""
+        return self.running()
 
 
 def http_fetch(port: int) -> str:
@@ -328,6 +372,663 @@ def run_overshoot_probe(gen: MatmulLoadGen, daemon: ExporterDaemon, log) -> int:
     return max(0, max_replicas_seen - NEED)
 
 
+# ---- wedged-tunnel containment ---------------------------------------------
+
+
+def run_phase_with_timeout(fn, seconds: float, label: str, log):
+    """Run a device-touching phase in an abandonable worker thread.
+
+    The device tunnel can wedge mid-dispatch (observed: the in-flight call
+    blocks on the connection reader forever; it cannot be interrupted from
+    Python).  A phase that exceeds its budget is ABANDONED — the daemon
+    worker thread stays blocked, the bench moves on and reports the phase as
+    an error — so one wedge costs one phase, never the whole (unattended)
+    bench run."""
+    result: dict = {}
+
+    def work():
+        try:
+            result["value"] = fn()
+        except Exception as e:
+            result["error"] = e
+
+    worker = threading.Thread(target=work, daemon=True, name=f"phase-{label}")
+    worker.start()
+    worker.join(timeout=seconds)
+    if worker.is_alive():
+        log(f"{label}: WEDGED (no completion in {seconds:.0f}s); abandoning phase")
+        raise RuntimeError(f"{label} wedged after {seconds:.0f}s (device tunnel stall)")
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+# ---- kernel rates (VERDICT r3 #2/#7: dwell MFU + the Pallas gap) -----------
+
+
+def measure_kernel_rates(gen: MatmulLoadGen, log) -> dict:
+    """Dwell-measured TFLOP/s: one long uninterrupted on-device matmul chain,
+    wall-clock timed — no RTT subtraction, no clamp (achieved < peak by
+    construction).  Also runs the SAME dwell through the Pallas kernel so the
+    XLA-vs-Pallas gap is a committed number, not prose (measured on v5e:
+    XLA dot ~184 TFLOP/s = ~93% MFU; Pallas 1024x1024 full-K ~159 = ~81%)."""
+    on_tpu = gen.peak_tflops is not None
+    iters = 2000 if on_tpu else 8
+    xla = gen.measure_dwell_tflops(iters)
+    out = {
+        "achieved_tflops": round(xla, 1),
+        "peak_tflops": gen.peak_tflops,
+        "mfu_pct": round(100.0 * xla / gen.peak_tflops, 1) if on_tpu else None,
+        "method": f"{iters}-iter chained dwell, wall-clock, no correction",
+    }
+    log(f"kernel: xla dot {xla:.1f} TFLOP/s" + (f" ({out['mfu_pct']}% MFU)" if on_tpu else ""))
+    from k8s_gpu_hpa_tpu.ops.pallas_matmul import HAVE_PALLAS
+
+    if not HAVE_PALLAS:
+        # MatmulLoadGen would silently fall back to jnp.dot — the "pallas"
+        # number would be a second XLA dwell, not a measurement
+        log("kernel: pallas unavailable on this backend; comparison skipped")
+        out["pallas_tflops"] = None
+        return out
+    try:
+        pgen = MatmulLoadGen(
+            size=gen.size, use_pallas=True, all_devices=False, intensity=1.0
+        )
+        pallas = pgen.measure_dwell_tflops(iters)
+        out["pallas_tflops"] = round(pallas, 1)
+        out["pallas_vs_xla"] = round(pallas / xla, 3)
+        log(f"kernel: pallas {pallas:.1f} TFLOP/s ({100 * pallas / xla:.0f}% of xla)")
+        del pgen
+    except Exception as e:  # e.g. mosaic lowering failure
+        log(f"kernel: pallas comparison skipped: {e}")
+        out["pallas_tflops"] = None
+    return out
+
+
+# ---- shared live-loop driver for the real-chip rungs -----------------------
+
+
+def _drive_live_rung(
+    clock: SystemClock,
+    deployment: MirrorDeployment,
+    scraper: Scraper,
+    evaluator: RuleEvaluator,
+    hpa: HPAController,
+    crossed_fn,
+    tick_fn,
+    log,
+    deadline_s: float = 300.0,
+) -> dict:
+    """Scrape at 1 Hz, sync the HPA every HPA_SYNC, measure metric-crossing ->
+    all-MAX_REPLICAS-running.  ``tick_fn(now)`` advances the workload (duty
+    command, allocation target); ``crossed_fn()`` reads the decision metric."""
+    t_cross = None
+    next_scrape = clock.now()
+    next_sync = clock.now() + HPA_SYNC
+    deadline = clock.now() + deadline_s
+    while clock.now() < deadline:
+        now = clock.now()
+        tick_fn(now)
+        if now >= next_scrape:
+            scraper.scrape_once()
+            evaluator.evaluate_once()
+            next_scrape = now + 1.0
+            if t_cross is None and crossed_fn():
+                t_cross = clock.now()
+                log(f"  metric crossed target at t={t_cross:.0f}")
+        if now >= next_sync:
+            status = hpa.sync_once()
+            next_sync = now + HPA_SYNC
+            log(
+                f"  sync: replicas={deployment.replicas} "
+                f"running={len(deployment.running())} ({status.last_reason})"
+            )
+        if (
+            t_cross is not None
+            and deployment.replicas == MAX_REPLICAS
+            and len(deployment.running()) == MAX_REPLICAS
+        ):
+            return {
+                "scale_up_s": round(clock.now() - t_cross, 2),
+                "replicas_reached": MAX_REPLICAS,
+            }
+        time.sleep(0.05)
+    raise RuntimeError("live rung did not reach max replicas before deadline")
+
+
+# ---- rung 2: v5e-8 HBM Pods metric, REAL device allocations ----------------
+
+
+class HbmHold:
+    """Holds real device arrays so the HBM-usage gauge is ground truth: the
+    bytes are actually resident on the chip (probed: 15.5 GiB allocatable on
+    this v5e), not a synthetic series."""
+
+    BLOCK = GIB // 4
+
+    def __init__(self):
+        self._blocks: list = []
+
+    def held_bytes(self) -> int:
+        return sum(a.nbytes for a in self._blocks)
+
+    def set_target(self, target_bytes: float) -> None:
+        import jax.numpy as jnp
+
+        while self.held_bytes() + self.BLOCK <= target_bytes:
+            arr = jnp.zeros((self.BLOCK,), jnp.uint8)
+            arr.block_until_ready()
+            self._blocks.append(arr)
+        while self._blocks and self.held_bytes() > target_bytes:
+            self._blocks.pop()
+
+    def clear(self) -> None:
+        self._blocks.clear()
+
+
+def run_rung_hbm_pods(log) -> dict:
+    """BASELINE configs[2] against the real chip: the shipped Pods-type HPA
+    (deploy/tpu-test-hbm-hpa.yaml, AverageValue 13Gi of the per-pod hottest
+    chip) closes the loop on REAL allocations.  One chip cannot be 8, so the
+    real pod's held bytes stand in for the hottest chip of each mirror pod —
+    the same mirror-pod convention as the headline trial."""
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-hbm-hpa.yaml").read_text())
+    (spec,) = metrics_from_manifest(hpa_doc)
+    target_bytes = spec.target_average_value
+    app = "tpu-test-v5e8"
+    clock = SystemClock()
+    deployment = MirrorDeployment(clock)
+    hold = HbmHold()
+    db = TimeSeriesDB(clock)
+    scraper = Scraper(db)
+
+    def pods_exporter() -> str:
+        held = float(hold.held_bytes())
+        chips, attribution = [], {}
+        for i, pod in enumerate(deployment.running()):
+            chips.append(ChipSample(i, None, None, held, 16 * GIB, None))
+            attribution[i] = ("default", pod)
+        return encode_text(families_from_chips(chips, "real-0", attribution))
+
+    def ksm() -> str:
+        fam = MetricFamily("kube_pod_labels", "gauge")
+        for pod in deployment.pods:
+            fam.add(1.0, namespace="default", pod=pod, label_app=app)
+        return encode_text([fam])
+
+    scraper.add_target(pods_exporter, name="exporter/hbm", node="real-0")
+    scraper.add_target(ksm, name="ksm")
+    evaluator = RuleEvaluator(db, [tpu_test_pod_max_rule(app=app)])
+    adapter = CustomMetricsAdapter(
+        db,
+        [
+            AdapterRule(
+                series="tpu_test_hbm_used_bytes",
+                resource_overrides={"namespace": "namespace", "pod": "Pod"},
+            )
+        ],
+    )
+    hpa = HPAController(
+        target=deployment,
+        metrics=[spec],
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+        pod_lister=deployment,
+    )
+
+    # total demand needs all 4 pods: at n running pods each holds
+    # min(demand/n, cap); cap > 13Gi*1.1 so the crossing is unambiguous
+    cap = 14.5 * GIB
+    demand = 44 * GIB
+    spike_at = clock.now() + 3.0
+
+    def tick(now: float) -> None:
+        want = demand if now >= spike_at else GIB // 2
+        share = min(want / max(1, len(deployment.running())), cap)
+        hold.set_target(share)
+
+    def crossed() -> bool:
+        values = adapter.get_pods_metric(
+            "default", "tpu_test_hbm_used_bytes", deployment.running()
+        )
+        return bool(values) and sum(values.values()) / len(values) > target_bytes
+
+    try:
+        result = _drive_live_rung(
+            clock, deployment, scraper, evaluator, hpa, crossed, tick, log
+        )
+    finally:
+        hold.clear()
+    result.update(
+        {
+            "mode": "real_chip",
+            "metric": "Pods tpu_test_hbm_used_bytes",
+            "target_average_gib": round(target_bytes / GIB, 1),
+            "signal": "real device allocations (hottest-chip bytes)",
+        }
+    )
+    return result
+
+
+# ---- rung 3: ResNet-50 training pod, multi-metric HPA ----------------------
+
+
+class _WindowedDuty:
+    """Busy-fraction over a sliding window (TrainStats.utilization is
+    cumulative since start — useless for detecting a load spike)."""
+
+    def __init__(self, window: float = 3.0):
+        self.window = window
+        self._events: list[tuple[float, float]] = []
+
+    def record(self, busy: float) -> None:
+        now = time.perf_counter()
+        self._events.append((now, busy))
+
+    def value(self) -> float:
+        now = time.perf_counter()
+        cutoff = now - self.window
+        self._events = [(t, b) for t, b in self._events if t >= cutoff]
+        if not self._events:
+            return 0.0
+        busy = sum(b for _, b in self._events)
+        wall = max(now - min(t for t, _ in self._events), busy, 1e-9)
+        return min(100.0, 100.0 * busy / wall)
+
+
+def run_rung_train_multimetric(log) -> dict:
+    """BASELINE configs[3] against the real chip: real ResNet-50 training
+    steps (fwd+bwd+BN+SGD on the MXU) drive the duty-cycle gauge; the HPA is
+    the shipped two-metric manifest (deploy/tpu-train-hpa.yaml).  The HBM-bw
+    gauge is honestly ABSENT in this environment (no libtpu metrics service
+    over the tunnel), which exercises autoscaling/v2's documented semantics:
+    the max over AVAILABLE metrics decides (control/hpa.py::sync_once) —
+    exactly what happens on nodes whose libtpu build lacks the bw counter."""
+    from k8s_gpu_hpa_tpu.loadgen.train import TrainLoadGen
+
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-train-hpa.yaml").read_text())
+    specs = metrics_from_manifest(hpa_doc)
+    clock = SystemClock()
+    deployment = MirrorDeployment(clock)
+    deployment.pods = {"tpu-train-real": -1.0}
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    log("  compiling ResNet-50 train step...")
+    train = TrainLoadGen(batch_size=64 if on_tpu else 8, image_size=32, small=not on_tpu)
+    train.warmup()
+    duty = _WindowedDuty()
+    intensity = {"value": 0.15}
+    stop = threading.Event()
+
+    def train_loop():
+        while not stop.is_set():
+            i = max(intensity["value"], 0.01)
+            # duty counts the WHOLE iteration as busy (train.step()'s own dt
+            # excludes the key-split dispatch, ~an RTT on this tunnel — the
+            # pod is not idle during it, merely host-bound)
+            t_iter = time.perf_counter()
+            train.step()
+            busy = time.perf_counter() - t_iter
+            duty.record(busy)
+            time.sleep(min(busy * (1.0 - i) / i, 2.0))
+
+    worker = threading.Thread(target=train_loop, daemon=True)
+
+    db = TimeSeriesDB(clock)
+    scraper = Scraper(db)
+
+    def duty_exporter() -> str:
+        d = duty.value()
+        chips, attribution = [], {}
+        for i, pod in enumerate(deployment.running()):
+            chips.append(ChipSample(i, None, d, 0.0, 0.0, None))
+            attribution[i] = ("default", pod)
+        return encode_text(families_from_chips(chips, "real-0", attribution))
+
+    def ksm() -> str:
+        fam = MetricFamily("kube_pod_labels", "gauge")
+        for pod in deployment.pods:
+            fam.add(1.0, namespace="default", pod=pod, label_app="tpu-train")
+        return encode_text([fam])
+
+    scraper.add_target(duty_exporter, name="exporter/train", node="real-0")
+    scraper.add_target(ksm, name="ksm")
+    evaluator = RuleEvaluator(
+        db,
+        [
+            tpu_test_avg_rule(
+                app="tpu-train",
+                deployment="tpu-train",
+                metric=TPU_DUTY_CYCLE,
+                record="tpu_train_duty_cycle_avg",
+            )
+            # tpu_train_hbm_bw_avg deliberately not produced: gauge absent
+        ],
+    )
+    adapter = CustomMetricsAdapter(
+        db,
+        [
+            AdapterRule(series="tpu_train_duty_cycle_avg"),
+            AdapterRule(series="tpu_train_hbm_bw_avg"),
+        ],
+    )
+    hpa = HPAController(
+        target=deployment,
+        metrics=specs,
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+
+    duty_target = next(
+        s.target_value for s in specs if s.metric_name == "tpu_train_duty_cycle_avg"
+    )
+    spike_at = clock.now() + 3.0
+
+    def tick(now: float) -> None:
+        # a training fleet's pods each run their own steps (per-pod load
+        # shape, like the reference's busyloop): the spike drives every pod
+        # to full duty, so the HPA rides to maxReplicas and pins there
+        intensity["value"] = 1.0 if now >= spike_at else 0.15
+
+    def crossed() -> bool:
+        value = db.latest("tpu_train_duty_cycle_avg", {"deployment": "tpu-train"})
+        return value is not None and value > duty_target
+
+    worker.start()
+    try:
+        result = _drive_live_rung(
+            clock, deployment, scraper, evaluator, hpa, crossed, tick, log
+        )
+    finally:
+        stop.set()
+        worker.join(timeout=30.0)
+    stats = train.stats()
+    result.update(
+        {
+            "mode": "real_chip",
+            "metric": "Object tpu_train_duty_cycle_avg + tpu_train_hbm_bw_avg",
+            "bw_gauge": "absent in this environment; v2 max-of-available semantics",
+            "train_steps": stats.steps,
+            "images_per_sec": round(stats.images_per_sec, 1),
+        }
+    )
+    return result
+
+
+# ---- virtual-time rungs (configs 0, 4, and the External queue rung) --------
+
+
+def run_rung_cpu_resource() -> dict:
+    """BASELINE configs[0] in virtual time: the shipped no-accelerator rung
+    (deploy/cpu-busyloop*.yaml, Resource-type metric on cpu) — per-pod
+    busyloop load, metrics-server stand-in, manifest behavior.  Mirrors
+    tests/test_resource_metrics.py's closed loop but MEASURES the latency."""
+    from k8s_gpu_hpa_tpu.control.cluster import (
+        SimCluster,
+        SimDeployment,
+        SimResourceMetrics,
+    )
+
+    hpa_doc = yaml.safe_load((DEPLOY / "cpu-busyloop-hpa.yaml").read_text())
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("node-0", 0)], pod_start_latency=3.0)
+    spike_at = 30.0
+    dep = SimDeployment(
+        cluster,
+        "cpu-busyloop",
+        "cpu-busyloop",
+        chips_per_pod=0,
+        load_fn=lambda t: 100.0 if t >= spike_at else 20.0,
+        load_mode="per_pod",
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(5.0)
+    target_util = hpa_doc["spec"]["metrics"][0]["resource"]["target"]["averageUtilization"]
+    max_replicas = hpa_doc["spec"]["maxReplicas"]
+    hpa = HPAController(
+        target=dep,
+        metrics=[ResourceMetricSpec("cpu", float(target_util))],
+        adapter=None,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=max_replicas,
+        behavior=behavior_from_manifest(hpa_doc),
+        resource_metrics=SimResourceMetrics(cluster, "cpu-busyloop"),
+    )
+    next_sync = 15.0
+    t_done = None
+    while clock.now() < 400.0:
+        if clock.now() >= next_sync:
+            hpa.sync_once()
+            next_sync += 15.0
+        if (
+            clock.now() >= spike_at
+            and dep.replicas == max_replicas
+            and len(cluster.running_pods(dep.name)) == max_replicas
+        ):
+            t_done = clock.now()
+            break
+        clock.advance(0.5)
+    assert t_done is not None, "cpu rung never reached max replicas"
+    return {
+        "mode": "virtual",
+        "metric": "Resource cpu averageUtilization",
+        "scale_up_s": round(t_done - spike_at, 1),
+        "replicas_reached": max_replicas,
+    }
+
+
+def run_rung_external_queue() -> dict:
+    """The External rung in virtual time: the shipped queue-depth HPA
+    (deploy/tpu-test-external-hpa.yaml) against a demand spike on
+    external.metrics.k8s.io semantics.  Control-plane latency only (no pod
+    lifecycle): spike -> steady desired replicas."""
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-external-hpa.yaml").read_text())
+    series = hpa_doc["spec"]["metrics"][0]["external"]["metric"]["name"]
+    labels = tuple(
+        sorted(
+            {
+                "namespace": "default",
+                **hpa_doc["spec"]["metrics"][0]["external"]["metric"]["selector"][
+                    "matchLabels"
+                ],
+            }.items()
+        )
+    )
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    adapter = CustomMetricsAdapter(db, [], external_rules=[ExternalRule(series)])
+
+    class Target:
+        replicas = 1
+
+        def scale_to(self, n):
+            self.replicas = n
+
+    target = Target()
+    hpa = HPAController(
+        target=target,
+        metrics=metrics_from_manifest(hpa_doc),
+        adapter=adapter,
+        clock=clock,
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=hpa_doc["spec"]["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+    spike_at = 10.0
+    need = 3  # 240 queued / 100-per-replica AverageValue -> 3
+    t_done = None
+    next_sync = 15.0
+    while clock.now() < 300.0:
+        db.append(series, labels, 240.0 if clock.now() >= spike_at else 40.0, clock.now())
+        if clock.now() >= next_sync:
+            hpa.sync_once()
+            next_sync += 15.0
+        if clock.now() >= spike_at and target.replicas == need:
+            t_done = clock.now()
+            break
+        clock.advance(1.0)
+    assert t_done is not None, "external rung never reached steady desired"
+    return {
+        "mode": "virtual",
+        "metric": f"External {series} AverageValue",
+        "spike_to_desired_s": round(t_done - spike_at, 1),
+        "replicas_reached": need,
+    }
+
+
+def run_rung_multihost_quantum() -> dict:
+    """BASELINE configs[4] in virtual time: 8 v5p hosts, slices of 2 hosts,
+    the shipped StatefulSet HPA with the replica-quantum annotation — measure
+    spike -> all 8 pods (4 slices) running, and that every scale event lands
+    on a slice boundary (partial slices serve nothing, SURVEY.md §7(d))."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.hpa import quantum_from_manifest
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-multihost-hpa.yaml").read_text())
+    quantum = quantum_from_manifest(hpa_doc)
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"v5p-node-{i}", 4) for i in range(8)],
+        pod_start_latency=POD_START_LATENCY,
+    )
+    spike_at = 60.0
+    dep = SimDeployment(
+        cluster,
+        "tpu-test-multihost",
+        "tpu-test-multihost",
+        chips_per_pod=4,
+        hosts_per_slice=quantum,
+        load_fn=lambda t: 320.0 if t >= spike_at else 20.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(dep, replicas=hpa_doc["spec"]["minReplicas"])
+    clock.advance(15.0)
+    max_replicas = hpa_doc["spec"]["maxReplicas"]
+    pipe = AutoscalingPipeline(
+        cluster,
+        dep,
+        record=hpa_doc["spec"]["metrics"][0]["object"]["metric"]["name"],
+        target_value=float(hpa_doc["spec"]["metrics"][0]["object"]["target"]["value"]),
+        min_replicas=hpa_doc["spec"]["minReplicas"],
+        max_replicas=max_replicas,
+        behavior=behavior_from_manifest(hpa_doc),
+        replica_quantum=quantum,
+        object_kind="StatefulSet",
+    )
+    pipe.start()
+    t_done = None
+    while clock.now() < 400.0:
+        clock.advance(0.5)
+        if (
+            clock.now() >= spike_at
+            and pipe.replicas() == max_replicas
+            and pipe.running() == max_replicas
+        ):
+            t_done = clock.now()
+            break
+    assert t_done is not None, "multihost rung never reached max replicas"
+    violations = sum(1 for _, _, new in pipe.scale_history if new % quantum != 0)
+    return {
+        "mode": "virtual",
+        "metric": "Object tpu_test_multihost_tensorcore_avg (quantum=2)",
+        "scale_up_s": round(t_done - spike_at, 1),
+        "replicas_reached": max_replicas,
+        "slice_boundary_violations": violations,
+    }
+
+
+# ---- pod-start sensitivity sweep (VERDICT r3 #5) ---------------------------
+
+
+def run_pod_start_sweep() -> list[dict]:
+    """Virtual-time sweep of pod-start latency {12, 30, 60} s with the
+    shipped tpu-test HPA behavior: (a) the 1->4 scale-up latency vs the 60 s
+    budget, (b) whether the behavior stanza still holds overshoot at 0 when
+    pods take 60 s to start (the reference's overshoot mechanism is exactly
+    stale-high metrics read while pods are still starting, README.md:123)."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+
+    hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-hpa.yaml").read_text())
+    results = []
+    for pod_start in (12.0, 30.0, 60.0):
+
+        def scenario(offered_fn, max_needed: int):
+            clock = VirtualClock()
+            cluster = SimCluster(
+                clock, nodes=[("n0", 8)], pod_start_latency=pod_start
+            )
+            dep = SimDeployment(
+                cluster, "tpu-test", "tpu-test", load_fn=offered_fn, load_mode="shared"
+            )
+            cluster.add_deployment(dep, replicas=1)
+            clock.advance(15.0)
+            pipe = AutoscalingPipeline(
+                cluster,
+                dep,
+                target_value=TARGET,
+                max_replicas=MAX_REPLICAS,
+                behavior=behavior_from_manifest(hpa_doc),
+            )
+            pipe.start()
+            t_cross = None
+            t_done = None
+            max_seen = 1
+            while clock.now() < 600.0:
+                clock.advance(0.5)
+                max_seen = max(max_seen, pipe.replicas())
+                value = pipe.db.latest(
+                    "tpu_test_tensorcore_avg", {"deployment": "tpu-test"}
+                )
+                if (
+                    t_cross is None
+                    and clock.now() >= 100.0
+                    and value is not None
+                    and value > TARGET
+                ):
+                    t_cross = clock.now()
+                if (
+                    t_done is None
+                    and t_cross is not None
+                    and pipe.replicas() >= max_needed
+                    and pipe.running() >= max_needed
+                ):
+                    t_done = clock.now()
+                    if max_needed == MAX_REPLICAS:
+                        break
+                if t_done is not None and clock.now() > t_done + 3 * HPA_SYNC:
+                    break  # overshoot observation window after steady need
+            return t_cross, t_done, max_seen
+
+        # budget case: spike needs all 4 replicas
+        t_cross, t_done, _ = scenario(
+            lambda t: 800.0 if t >= 100.0 else 20.0, MAX_REPLICAS
+        )
+        latency = round(t_done - t_cross, 1) if t_cross and t_done else None
+        # overshoot case: offered load needs exactly 3 of 4
+        _, _, max_seen = scenario(lambda t: 100.0 if t >= 100.0 else 20.0, 3)
+        results.append(
+            {
+                "pod_start_s": pod_start,
+                "scale_up_s": latency,
+                "budget_pass": latency is not None and latency <= BUDGET_S,
+                "overshoot": max(0, max_seen - 3),
+            }
+        )
+    return results
+
+
 def main() -> None:
     log = lambda msg: print(msg, file=sys.stderr, flush=True)
     import jax
@@ -338,13 +1039,19 @@ def main() -> None:
     gen = MatmulLoadGen(size=size, intensity=0.2, window=3.0)
     # don't let a stray intensity file override the commanded duty cycle
     gen.intensity_file = f"/tmp/bench-intensity-{id(gen)}"
-    gen.warmup()
-    if gen.peak_tflops is None:
-        # CPU smoke fallback: no public peak for this backend — calibrate a
-        # synthetic one from a full-tilt burst so the tensorcore series
-        # exists and tracks duty cycle (on TPU the real peak is used)
-        gen.step()
-        gen.peak_tflops = max(gen.stats().achieved_tflops, 1e-9)
+
+    def warm():
+        gen.warmup()
+        if gen.peak_tflops is None:
+            # CPU smoke fallback: no public peak for this backend —
+            # calibrate a synthetic one from a full-tilt burst so the
+            # tensorcore series exists and tracks duty cycle
+            gen.step()
+            gen.peak_tflops = max(gen.stats().achieved_tflops, 1e-9)
+
+    # a tunnel wedge during warmup means nothing real can be measured:
+    # fail fast with a clear error instead of hanging unattended
+    run_phase_with_timeout(warm, 240.0, "warmup", log)
     # duty cycle (busy fraction) and genuine MXU rate, distinct by design
     source = JaxDeviceSource(
         util_fn=lambda i: gen.utilization(),
@@ -360,17 +1067,25 @@ def main() -> None:
 
     # background threads: the load generator runs continuously (as it would in
     # its own pod), and a feeder keeps the exporter fed with fresh sweeps
-    import threading
-
     stop = threading.Event()
 
     def generate():
         while not stop.is_set():
-            gen.step()
+            try:
+                gen.step()
+            except Exception as e:
+                # a transiently wedged device tunnel must not silently kill
+                # the generator thread (every later trial would read 0.0
+                # utilization and time out); log, back off, retry
+                log(f"loadgen step failed ({type(e).__name__}: {e}); retrying")
+                time.sleep(1.0)
 
     def feed():
         while not stop.is_set():
-            daemon.step()
+            try:
+                daemon.step()
+            except Exception as e:
+                log(f"exporter feed failed ({type(e).__name__}: {e}); retrying")
             time.sleep(0.5)
 
     threads = [
@@ -380,6 +1095,7 @@ def main() -> None:
     for t in threads:
         t.start()
 
+    budget_failures: list[str] = []
     try:
         trials = []
         for trial in range(3):
@@ -396,23 +1112,90 @@ def main() -> None:
         if not trials:
             raise RuntimeError("no trial completed")
         log("overshoot probe:")
-        overshoot = run_overshoot_probe(gen, daemon, log)
-        log(f"  overshoot: {overshoot}")
+        try:
+            overshoot = run_overshoot_probe(gen, daemon, log)
+            log(f"  overshoot: {overshoot}")
+        except RuntimeError as e:
+            # a wedged probe must not discard the completed trials
+            # (same per-trial resilience rationale as above)
+            log(f"  overshoot probe failed: {e}")
+            overshoot = None
 
         def p50_of(key: str):
             values = [t[key] for t in trials if t[key] is not None]
             return round(statistics.median(values), 2) if values else None
 
         p50 = statistics.median(t["scale_up"] for t in trials)
+        scale_down_p50 = p50_of("scale_down")
+        scale_down_flaps = sum(t["scale_down_flaps"] for t in trials)
+
+        # quiesce the headline generator, then measure kernel rates on the
+        # idle chip (one long dwell each for XLA dot and the Pallas kernel)
+        gen.set_intensity(0.0)
+        time.sleep(1.0)
+        log("kernel rates:")
+        try:
+            kernel = run_phase_with_timeout(
+                lambda: measure_kernel_rates(gen, log), 300.0, "kernel", log
+            )
+        except Exception as e:
+            log(f"kernel measurement failed: {e}")
+            kernel = {"error": str(e)}
         stats = gen.stats()
-        achieved = stats.achieved_tflops
-        if gen.peak_tflops is not None:
-            achieved = min(achieved, gen.peak_tflops)
-        log(
-            f"loadgen: achieved {achieved:.1f} TFLOP/s busy-time, "
-            f"{stats.sustained_tflops:.1f} sustained "
-            f"({backend}, {size}x{size} bf16)"
-        )
+        kernel["sustained_tflops_during_trials"] = round(stats.sustained_tflops, 1)
+
+        rungs: dict[str, dict] = {}
+        rungs["1_tensorcore_object"] = {
+            "mode": "real_chip",
+            "metric": "Object tpu_test_tensorcore_avg",
+            "scale_up_p50_s": round(p50, 2),
+            "replicas_reached": MAX_REPLICAS,
+        }
+        for name, fn, live in (
+            ("0_cpu_resource", run_rung_cpu_resource, False),
+            ("2_hbm_pods", lambda: run_rung_hbm_pods(log), True),
+            ("3_train_multimetric", lambda: run_rung_train_multimetric(log), True),
+            ("external_queue", run_rung_external_queue, False),
+            ("4_multihost_quantum", run_rung_multihost_quantum, False),
+        ):
+            log(f"rung {name}:")
+            try:
+                # live rungs dispatch to the device from their driving loop:
+                # contain a wedged tunnel to the one rung (600 s covers the
+                # train rung's ResNet-50 compile + trial)
+                rungs[name] = (
+                    run_phase_with_timeout(fn, 600.0, f"rung {name}", log)
+                    if live
+                    else fn()
+                )
+                log(f"  {rungs[name]}")
+            except Exception as e:
+                # a rung that cannot complete reports its failure rather
+                # than sinking the whole bench
+                log(f"  rung failed: {e}")
+                rungs[name] = {"mode": "real_chip" if live else "virtual", "error": str(e)}
+
+        log("pod-start sensitivity sweep:")
+        sweep = run_pod_start_sweep()
+        for case in sweep:
+            log(f"  {case}")
+
+        scale_down_budget = {
+            "target_p50_s": SCALE_DOWN_BUDGET_S,
+            "max_flaps": SCALE_DOWN_MAX_FLAPS,
+            "pass": (
+                scale_down_p50 is not None
+                and scale_down_p50 <= SCALE_DOWN_BUDGET_S
+                and scale_down_flaps <= SCALE_DOWN_MAX_FLAPS
+            ),
+        }
+        if not scale_down_budget["pass"]:
+            budget_failures.append(
+                f"scale-down budget violated: p50={scale_down_p50}s "
+                f"(target <= {SCALE_DOWN_BUDGET_S}), flaps={scale_down_flaps} "
+                f"(max {SCALE_DOWN_MAX_FLAPS})"
+            )
+
         print(
             json.dumps(
                 {
@@ -429,12 +1212,13 @@ def main() -> None:
                         "hpa_sync_interval": HPA_SYNC,
                         "pod_start_latency": POD_START_LATENCY,
                     },
-                    "scale_down_p50_s": p50_of("scale_down"),
-                    "scale_down_flaps": sum(t["scale_down_flaps"] for t in trials),
+                    "scale_down_p50_s": scale_down_p50,
+                    "scale_down_flaps": scale_down_flaps,
+                    "scale_down_budget": scale_down_budget,
                     "overshoot_count": overshoot,
-                    "achieved_tflops": round(achieved, 1),
-                    "sustained_tflops": round(stats.sustained_tflops, 1),
-                    "peak_tflops": gen.peak_tflops,
+                    "kernel": kernel,
+                    "rungs": rungs,
+                    "pod_start_sensitivity": sweep,
                 }
             )
         )
@@ -446,6 +1230,10 @@ def main() -> None:
         for t in threads:
             t.join(timeout=10.0)
         daemon.close()
+    if budget_failures:
+        for failure in budget_failures:
+            log(f"BUDGET FAIL: {failure}")
+        sys.exit(2)
 
 
 if __name__ == "__main__":

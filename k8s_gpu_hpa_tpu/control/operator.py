@@ -37,7 +37,17 @@ Lease (held by pod name, renewed each reconcile interval) guards the
 rolling-update window where two replicas briefly coexist — only the lease
 holder patches.  A tiny HTTP server exposes ``/healthz`` (reconcile loop
 recently ticked) and ``/readyz`` (holding the lease) for the Deployment's
-probes (deploy/quantum-operator.yaml).
+probes, plus ``/metrics`` (deploy/quantum-operator.yaml).
+
+Self-observability: every other shipped component self-reports (the
+exporter's own up/staleness counters, cpp/exporter/tpu_exporter.cc); the one
+component that patches live workloads must too.  ``/metrics`` serves
+reconcile/repair/suppression/lease counters and — critically —
+``quantum_operator_partial_slice_held``: the steady-hold rule deliberately
+leaves a stranded partial-slice host running (the lesser evil vs a patch
+war, above), which is real capacity serving nothing; the gauge makes that
+divergence visible and the shipped ``TpuSliceHeldPartial`` alert
+(metrics/rules.py) pages on it instead of letting it stay silent.
 
 Everything is stdlib REST against the API server (service-account token, no
 kubernetes client dependency) — the same pattern as exporter/kubeapi.py.
@@ -130,6 +140,66 @@ class _LeaseLost(Exception):
     """Raised mid-reconcile when the leadership re-check fails."""
 
 
+class OperatorMetrics:
+    """Prometheus self-metrics, rendered with the package's own encoder so
+    the text format is byte-compatible with every other exporter here."""
+
+    def __init__(self):
+        self.reconciles_total = 0
+        self.repairs_total = {"up": 0, "down": 0}
+        self.suppressed_repairs_total = 0
+        self.lease_transitions_total = 0
+        #: target ("StatefulSet/name") -> 1.0 while the steady-hold rule is
+        #: holding it off a slice boundary (stranded capacity), else 0.0;
+        #: cleared entries stay exported as 0 so the alert expr sees the
+        #: transition rather than a vanished series
+        self.partial_slice_held: dict[str, float] = {}
+
+    def set_held(self, target: str, held: bool) -> None:
+        self.partial_slice_held[target] = 1.0 if held else 0.0
+
+    def render(self) -> str:
+        from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+        from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+
+        reconciles = MetricFamily(
+            "quantum_operator_reconciles_total",
+            "counter",
+            "completed reconcile passes over the namespace's annotated HPAs",
+        )
+        reconciles.add(float(self.reconciles_total))
+        repairs = MetricFamily(
+            "quantum_operator_repairs_total",
+            "counter",
+            "scale-subresource patches applied, by direction",
+        )
+        for direction, count in sorted(self.repairs_total.items()):
+            repairs.add(float(count), direction=direction)
+        suppressed = MetricFamily(
+            "quantum_operator_suppressed_repairs_total",
+            "counter",
+            "repairs withheld by the revert-war suppression guard",
+        )
+        suppressed.add(float(self.suppressed_repairs_total))
+        lease = MetricFamily(
+            "quantum_operator_lease_transitions_total",
+            "counter",
+            "leadership changes observed by this replica (acquired or lost)",
+        )
+        lease.add(float(self.lease_transitions_total))
+        held = MetricFamily(
+            "quantum_operator_partial_slice_held",
+            "gauge",
+            "1 while the steady-hold rule leaves this target off a slice "
+            "boundary (a stranded host serving nothing); alert: TpuSliceHeldPartial",
+        )
+        # snapshot: render() runs on the HTTP daemon thread while the
+        # reconcile thread inserts first-seen targets
+        for target, value in sorted(dict(self.partial_slice_held).items()):
+            held.add(value, target=target)
+        return encode_text([reconciles, repairs, suppressed, lease, held])
+
+
 @dataclass
 class RepairAction:
     hpa: str
@@ -189,6 +259,11 @@ class QuantumOperator:
         self.client = client
         self.namespace = namespace
         self.elector = elector
+        self.metrics = OperatorMetrics()
+        #: targets visited this reconcile pass (stale held-gauge cleanup)
+        self._seen_targets: set[str] = set()
+        #: last observed leadership, for the transition counter
+        self._was_leader: bool | None = None
         #: liveness signal: wall-clock of the last completed loop iteration
         self.last_tick: float = time.monotonic()
         #: target -> (current, hpa_desired, patched_to) of the last repair,
@@ -211,6 +286,8 @@ class QuantumOperator:
 
     def reconcile_once(self) -> list[RepairAction]:
         actions: list[RepairAction] = []
+        self._seen_targets: set[str] = set()
+        aborted = False
         for hpa in self._list_hpas():
             try:
                 action = self._reconcile_hpa(hpa)
@@ -218,6 +295,7 @@ class QuantumOperator:
                 # a slow pass can outlive the lease: a standby may already
                 # be patching — abort the whole pass rather than split-brain
                 print("lost lease mid-reconcile; aborting pass", flush=True)
+                aborted = True
                 break
             except Exception as e:
                 # one malformed HPA (typo'd annotation, deleted target) must
@@ -237,6 +315,13 @@ class QuantumOperator:
             self._error_logged.pop(hpa.get("metadata", {}).get("name", "?"), None)
             if action is not None:
                 actions.append(action)
+        if not aborted:
+            # a target whose HPA vanished (or lost its annotation) mid-hold
+            # must not leave a stale held=1 paging forever
+            for target in self.metrics.partial_slice_held:
+                if target not in self._seen_targets:
+                    self.metrics.set_held(target, False)
+        self.metrics.reconciles_total += 1
         return actions
 
     def _reconcile_hpa(self, hpa: dict) -> RepairAction | None:
@@ -264,6 +349,11 @@ class QuantumOperator:
             return None
         self._misconfig_logged.discard(name)
         group, plural = SCALE_PATHS[ref["kind"]]
+        # mark the target seen BEFORE any API call that can transiently fail:
+        # one flaky scale GET must not make the cleanup below read the target
+        # as deleted and zero its held gauge (resetting the alert's for: timer)
+        target = f"{ref['kind']}/{ref['name']}"
+        self._seen_targets.add(target)
         scale_path = (
             f"/apis/{group}/namespaces/{self.namespace}"
             f"/{plural}/{ref['name']}/scale"
@@ -281,7 +371,13 @@ class QuantumOperator:
             int(spec.get("minReplicas", 1)),
             max_replicas,
         )
-        target = f"{ref['kind']}/{ref['name']}"
+        # the steady-hold divergence, made visible: off-boundary with the HPA
+        # steady means a stranded partial-slice host is being deliberately
+        # left running (module docstring) — gauge it so TpuSliceHeldPartial
+        # can page instead of the capacity loss staying silent
+        self.metrics.set_held(
+            target, desired == current and current % q != 0 and hpa_desired == current
+        )
         if desired == current:
             last = self._last_repair.get(target)
             if last is not None and current == last[2] and hpa_desired == last[1]:
@@ -301,6 +397,7 @@ class QuantumOperator:
             # we already repaired this exact observed state and something
             # (the vanilla HPA) reverted it — repeating the patch would
             # loop forever; suppress until the state genuinely changes
+            self.metrics.suppressed_repairs_total += 1
             if target not in self._suppressed_logged:
                 self._suppressed_logged.add(target)
                 print(
@@ -320,6 +417,7 @@ class QuantumOperator:
         self._last_repair[target] = (current, hpa_desired, desired)
         self._suppressed_logged.discard(target)
         direction = "up" if desired > current else "down"
+        self.metrics.repairs_total[direction] += 1
         return RepairAction(
             hpa=name,
             target=target,
@@ -333,8 +431,13 @@ class QuantumOperator:
 
     def tick(self) -> list[RepairAction]:
         """One loop iteration: leader check (when electing), then reconcile."""
-        if self.elector is not None and not self.elector.ensure_leader():
-            return []
+        if self.elector is not None:
+            leader = self.elector.ensure_leader()
+            if self._was_leader is not None and leader != self._was_leader:
+                self.metrics.lease_transitions_total += 1
+            self._was_leader = leader
+            if not leader:
+                return []
         return self.reconcile_once()
 
     def run_forever(self, interval: float = 5.0) -> None:
@@ -489,7 +592,8 @@ def start_health_server(
     operator: QuantumOperator, port: int, stale_after: float = 60.0
 ) -> HTTPServer:
     """``/healthz``: loop ticked within ``stale_after`` s; ``/readyz``: that,
-    plus holding the lease (when electing).  Serves in a daemon thread."""
+    plus holding the lease (when electing); ``/metrics``: the operator's
+    Prometheus self-metrics (OperatorMetrics).  Serves in a daemon thread."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -497,6 +601,16 @@ def start_health_server(
 
         def do_GET(self):
             fresh = time.monotonic() - operator.last_tick < stale_after
+            if self.path == "/metrics":
+                body = operator.metrics.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path == "/healthz":
                 ok = fresh
             elif self.path == "/readyz":

@@ -182,7 +182,7 @@ def workload_deployment(
 
     ``node_selector``/``tolerations`` replace the GKE-provisioned defaults
     wholesale for clusters without the GKE TPU labels — the analog of the
-    reference's hand-applied ``accelerator=nvidia`` node label
+    reference's hand-applied ``accelerator=nvidia-gpu`` node label
     (README.md:26-30, dcgm-exporter.yaml:22-23)."""
     return {
         "apiVersion": "apps/v1",
@@ -425,7 +425,36 @@ def prom_stack_values() -> dict:
                                 "action": "replace",
                             },
                         ],
-                    }
+                    },
+                    {
+                        # the quantum operator's self-metrics (reconcile/
+                        # repair/suppression counters and the
+                        # partial_slice_held gauge the TpuSliceHeldPartial
+                        # alert consumes) — served on the health port,
+                        # control/operator.py::OperatorMetrics
+                        "job_name": "quantum-operator",
+                        "scrape_interval": "15s",
+                        "metrics_path": "/metrics",
+                        "kubernetes_sd_configs": [
+                            {"role": "pod", "namespaces": {"names": ["default"]}}
+                        ],
+                        "relabel_configs": [
+                            {
+                                "source_labels": [
+                                    "__meta_kubernetes_pod_label_app"
+                                ],
+                                "regex": "quantum-operator",
+                                "action": "keep",
+                            },
+                            {
+                                "source_labels": [
+                                    "__meta_kubernetes_pod_container_port_name"
+                                ],
+                                "regex": "health",
+                                "action": "keep",
+                            },
+                        ],
+                    },
                 ]
             }
         }
@@ -1094,7 +1123,7 @@ class PipelineSpec:
     max_slices: int = 4
     #: non-GKE fallback: replace the GKE-provisioned node labels/taints with
     #: hand-applied ones (reference README.md:26-30 labels nodes
-    #: ``accelerator=nvidia`` by hand on non-GKE clusters).  Setting
+    #: ``accelerator=nvidia-gpu`` by hand on non-GKE clusters).  Setting
     #: ``node_selector`` also makes the pipeline carry its own exporter
     #: DaemonSet, since the bundle's GKE-labeled one would not schedule.
     node_selector: dict[str, str] | None = None

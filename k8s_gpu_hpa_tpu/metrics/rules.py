@@ -510,6 +510,34 @@ def chip_hot_alert(threshold_c: float = 90.0) -> AlertRule:
     )
 
 
+def slice_held_partial_alert(for_seconds: float = 300.0) -> AlertRule:
+    """The quantum operator's steady-hold rule deliberately leaves a target
+    off a slice boundary rather than start a patch war with the vanilla HPA
+    (control/operator.py module docstring) — a stranded partial-slice host
+    burning capacity while serving nothing.  That divergence is by design,
+    but it must not be SILENT: the operator gauges it
+    (``quantum_operator_partial_slice_held``, served on its health port) and
+    this alert pages when a hold persists — the operator's own docstring
+    names the usual root cause (minReplicas/maxReplicas not slice
+    multiples), which is the fix."""
+    return AlertRule(
+        alert="TpuSliceHeldPartial",
+        expr=Cmp(
+            Aggregate("max", Select("quantum_operator_partial_slice_held")),
+            ">",
+            0,
+        ),
+        for_seconds=for_seconds,
+        labels={"severity": "warning"},
+        annotations={
+            "summary": "the slice-quantum operator has been holding a target "
+            "on a partial slice for 5m: stranded hosts are running but "
+            "serving nothing — make the HPA's minReplicas/maxReplicas slice "
+            "multiples so the vanilla HPA stops landing off-boundary"
+        },
+    )
+
+
 def shipped_alert_rules() -> list[AlertRule]:
     """THE shipped alert list — single source for manifests.py, the YAML
     generator (tools/gen_prometheusrule.py), and the parity test.  The serve
@@ -520,6 +548,7 @@ def shipped_alert_rules() -> list[AlertRule]:
         flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve"),
         device_counters_dead_alert(),
         chip_hot_alert(),
+        slice_held_partial_alert(),
     ]
 
 

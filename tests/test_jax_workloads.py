@@ -188,3 +188,30 @@ def test_matmul_loadgen_single_device_when_pinned():
 
     gen = MatmulLoadGen(size=128, device=jax.devices()[0])
     assert gen.n_devices == 1
+
+
+def test_matmul_dwell_measurement_is_uncorrected():
+    """The honest-MFU path (VERDICT r3 weak #2): one chained burst, wall-clock
+    timed, no RTT subtraction and no clamp — a plain positive rate."""
+    gen = MatmulLoadGen(size=256, iters_per_burst=2, intensity=1.0, use_pallas=False)
+    gen.warmup()
+    rate = gen.measure_dwell_tflops(iters=4)
+    assert rate > 0.0
+    # no clamp: the dwell is a direct flops/wall ratio, never pinned to peak
+    if gen.peak_tflops is not None:
+        assert rate < gen.peak_tflops * gen.n_devices
+
+
+def test_matmul_stats_caps_and_flags_rtt_overcorrection():
+    """An RTT estimate larger than the bursts would make the busy-time rate
+    explode (ADVICE r3: the 0.1*b floor can inflate it ~10x); stats() must
+    cap at device peak when known and flag the estimate as floor-clamped."""
+    gen = MatmulLoadGen(size=256, iters_per_burst=1, intensity=1.0, use_pallas=False)
+    gen.warmup()
+    for _ in range(3):
+        gen.step()
+    gen._rtt = 1e6  # absurd calibration: every burst hits the 10% floor
+    gen.peak_tflops = 0.001  # tiny "peak" so the inflated rate exceeds it
+    stats = gen.stats()
+    assert stats.floor_clamped
+    assert stats.achieved_tflops == gen.peak_tflops * gen.n_devices

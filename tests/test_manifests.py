@@ -202,6 +202,13 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
             "kube_pod_labels",
             # Prometheus' own alert-state series (the alerts panel)
             "ALERTS",
+            # quantum-operator self-metrics (control/operator.py::
+            # OperatorMetrics, scraped by the quantum-operator job)
+            "quantum_operator_partial_slice_held",
+            "quantum_operator_repairs_total",
+            "quantum_operator_suppressed_repairs_total",
+            "quantum_operator_reconciles_total",
+            "quantum_operator_lease_transitions_total",
         }
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
@@ -210,7 +217,7 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         names = {
             tok
             for tok in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr)
-            if tok.startswith(("tpu_", "kube_", "ALERTS"))
+            if tok.startswith(("tpu_", "kube_", "ALERTS", "quantum_operator_"))
         }
         assert names, f"no metric reference in {expr!r}"
         assert names <= known, f"unknown series in {expr!r}: {names - known}"

@@ -271,6 +271,67 @@ def build_dashboard() -> dict:
                 )
             ],
         },
+        {
+            "id": 9,
+            "type": "stat",
+            "title": "Partial slices held",
+            "description": "Targets the quantum operator is deliberately "
+            "holding off a slice boundary (steady-hold rule): stranded "
+            "hosts running but serving nothing.  Nonzero sustained 5m "
+            "fires TpuSliceHeldPartial; the fix is making the HPA's "
+            "replica bounds slice multiples (control/operator.py).",
+            "gridPos": {"h": 8, "w": 12, "x": 0, "y": 32},
+            "datasource": {"type": "prometheus", "uid": "${datasource}"},
+            "fieldConfig": {
+                "defaults": {
+                    "thresholds": {
+                        "mode": "absolute",
+                        "steps": [
+                            {"color": "green", "value": None},
+                            {"color": "red", "value": 1},
+                        ],
+                    },
+                },
+                "overrides": [],
+            },
+            "options": {
+                "colorMode": "background",
+                "graphMode": "none",
+                "reduceOptions": {"calcs": ["lastNotNull"]},
+                "textMode": "value_and_name",
+            },
+            "targets": [
+                _target(
+                    "sum(quantum_operator_partial_slice_held) or vector(0)",
+                    "held",
+                    "A",
+                )
+            ],
+        },
+        _ts_panel(
+            10,
+            "Quantum operator repairs",
+            12,
+            32,
+            [
+                _target(
+                    "sum by(direction) "
+                    "(increase(quantum_operator_repairs_total[5m]))",
+                    "repairs {{direction}}",
+                    "A",
+                ),
+                _target(
+                    "increase(quantum_operator_suppressed_repairs_total[5m])",
+                    "suppressed",
+                    "B",
+                ),
+            ],
+            "Scale-subresource patches the operator applied per 5m, by "
+            "direction, and repairs withheld by the revert-war suppression "
+            "guard.  Sustained suppression means another controller owns "
+            "the count — check that minReplicas/maxReplicas are slice "
+            "multiples.",
+        ),
     ]
     return {
         "title": "TPU HPA pipeline",
