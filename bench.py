@@ -412,12 +412,26 @@ def measure_kernel_rates(gen: MatmulLoadGen, log) -> dict:
     construction).  Also runs the SAME dwell through the Pallas kernel so the
     XLA-vs-Pallas gap is a committed number, not prose (measured on v5e:
     XLA dot ~184 TFLOP/s = ~93% MFU; Pallas 1024x1024 full-K ~159 = ~81%)."""
-    on_tpu = gen.peak_tflops is not None
+    import jax
+
+    # MFU is only meaningful against a real hardware peak: on non-TPU
+    # backends gen.peak_tflops is a synthetic calibration constant (main()'s
+    # CPU fallback) and achieved/peak would print nonsense like 250%
+    on_tpu = jax.default_backend() == "tpu" and gen.peak_tflops is not None
     iters = 2000 if on_tpu else 8
-    xla = gen.measure_dwell_tflops(iters)
+    # per-chip numbers throughout: a multi-chip gen's dwell is an aggregate
+    # rate, which would inflate MFU by n_devices and make the Pallas ratio
+    # (measured single-device below) an artifact of device count
+    xgen = (
+        gen
+        if gen.n_devices == 1
+        else MatmulLoadGen(size=gen.size, all_devices=False, intensity=1.0)
+    )
+    xla = xgen.measure_dwell_tflops(iters)
     out = {
         "achieved_tflops": round(xla, 1),
-        "peak_tflops": gen.peak_tflops,
+        "per_chip": True,
+        "peak_tflops": gen.peak_tflops if on_tpu else None,
         "mfu_pct": round(100.0 * xla / gen.peak_tflops, 1) if on_tpu else None,
         "method": f"{iters}-iter chained dwell, wall-clock, no correction",
     }
@@ -443,6 +457,14 @@ def measure_kernel_rates(gen: MatmulLoadGen, log) -> dict:
         log(f"kernel: pallas comparison skipped: {e}")
         out["pallas_tflops"] = None
     return out
+
+
+def _live_mode() -> str:
+    """Honest mode label for the live rungs: they use the real chip when one
+    is present, the host CPU otherwise (dev/smoke runs)."""
+    import jax
+
+    return "real_chip" if jax.default_backend() == "tpu" else "cpu_fallback"
 
 
 # ---- shared live-loop driver for the real-chip rungs -----------------------
@@ -532,6 +554,25 @@ def run_rung_hbm_pods(log) -> dict:
     chip) closes the loop on REAL allocations.  One chip cannot be 8, so the
     real pod's held bytes stand in for the hottest chip of each mirror pod —
     the same mirror-pod convention as the headline trial."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        # cpu fallback allocates real HOST RAM: crossing the manifest's 13Gi
+        # target needs ~14.5 GiB resident — only attempt it with headroom
+        # (an OOM kill cannot be contained by the phase timeout)
+        try:
+            meminfo = Path("/proc/meminfo").read_text()
+            available_kb = int(
+                next(l for l in meminfo.splitlines() if "MemAvailable" in l).split()[1]
+            )
+        except Exception:
+            available_kb = 0
+        if available_kb * 1024 < 24 * GIB:
+            raise RuntimeError(
+                "hbm rung skipped on cpu fallback: needs ~14.5 GiB resident "
+                f"host RAM, only {available_kb // (1 << 20)} GiB available"
+            )
+
     hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-hbm-hpa.yaml").read_text())
     (spec,) = metrics_from_manifest(hpa_doc)
     target_bytes = spec.target_average_value
@@ -604,7 +645,7 @@ def run_rung_hbm_pods(log) -> dict:
         hold.clear()
     result.update(
         {
-            "mode": "real_chip",
+            "mode": _live_mode(),
             "metric": "Pods tpu_test_hbm_used_bytes",
             "target_average_gib": round(target_bytes / GIB, 1),
             "signal": "real device allocations (hottest-chip bytes)",
@@ -753,7 +794,7 @@ def run_rung_train_multimetric(log) -> dict:
     stats = train.stats()
     result.update(
         {
-            "mode": "real_chip",
+            "mode": _live_mode(),
             "metric": "Object tpu_train_duty_cycle_avg + tpu_train_hbm_bw_avg",
             "bw_gauge": "absent in this environment; v2 max-of-available semantics",
             "train_steps": stats.steps,
@@ -1129,6 +1170,9 @@ def main() -> None:
         scale_down_p50 = p50_of("scale_down")
         scale_down_flaps = sum(t["scale_down_flaps"] for t in trials)
 
+        # capture the trial-era windowed rate BEFORE quiescing: the stats
+        # window (3 s) would drain to zero within a second of intensity 0
+        trial_stats = gen.stats()
         # quiesce the headline generator, then measure kernel rates on the
         # idle chip (one long dwell each for XLA dot and the Pallas kernel)
         gen.set_intensity(0.0)
@@ -1141,12 +1185,11 @@ def main() -> None:
         except Exception as e:
             log(f"kernel measurement failed: {e}")
             kernel = {"error": str(e)}
-        stats = gen.stats()
-        kernel["sustained_tflops_during_trials"] = round(stats.sustained_tflops, 1)
+        kernel["sustained_tflops_end_of_trials"] = round(trial_stats.sustained_tflops, 1)
 
         rungs: dict[str, dict] = {}
         rungs["1_tensorcore_object"] = {
-            "mode": "real_chip",
+            "mode": _live_mode(),
             "metric": "Object tpu_test_tensorcore_avg",
             "scale_up_p50_s": round(p50, 2),
             "replicas_reached": MAX_REPLICAS,
@@ -1173,7 +1216,10 @@ def main() -> None:
                 # a rung that cannot complete reports its failure rather
                 # than sinking the whole bench
                 log(f"  rung failed: {e}")
-                rungs[name] = {"mode": "real_chip" if live else "virtual", "error": str(e)}
+                rungs[name] = {
+                    "mode": _live_mode() if live else "virtual",
+                    "error": str(e),
+                }
 
         log("pod-start sensitivity sweep:")
         sweep = run_pod_start_sweep()

@@ -321,7 +321,10 @@ class QuantumOperator:
             for target in self.metrics.partial_slice_held:
                 if target not in self._seen_targets:
                     self.metrics.set_held(target, False)
-        self.metrics.reconciles_total += 1
+            # counts COMPLETED passes only (the family's help text): a
+            # lease-flapping replica aborting mid-namespace must not read
+            # as a healthy reconcile rate
+            self.metrics.reconciles_total += 1
         return actions
 
     def _reconcile_hpa(self, hpa: dict) -> RepairAction | None:

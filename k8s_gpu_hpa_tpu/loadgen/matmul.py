@@ -392,6 +392,7 @@ def main() -> None:
             mxu = gen.mxu_utilization()
             print(
                 f"util={s.utilization:.1f}% achieved={s.achieved_tflops:.1f}TFLOP/s"
+                + (" (floor-clamped)" if s.floor_clamped else "")
                 + (f" mxu={mxu:.1f}%" if mxu is not None else "")
                 + f" steps={s.steps}",
                 flush=True,

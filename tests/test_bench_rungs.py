@@ -1,0 +1,73 @@
+"""The bench's virtual-time BASELINE rungs and the pod-start sensitivity
+sweep (bench.py), pinned against regressions.
+
+The real-chip phases (headline trials, HBM Pods rung, train rung, kernel
+dwell) need the TPU and are exercised by the driver's bench run; everything
+virtual-time is deterministic and cheap enough to test here — these are the
+published numbers for configs 0 and 4 and the External rung, so a silent
+break would ship a wrong BENCH json.
+"""
+
+from pathlib import Path
+import sys
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench
+
+
+def test_cpu_resource_rung_reaches_max_and_reports_latency():
+    result = bench.run_rung_cpu_resource()
+    assert result["mode"] == "virtual"
+    assert result["replicas_reached"] == 4
+    # spike -> 4/4 running: at least one 15s sync + 3s pod start, and well
+    # under the budget (the CPU rung has no exporter pipeline in the loop)
+    assert 15.0 <= result["scale_up_s"] <= bench.BUDGET_S
+
+
+def test_external_queue_rung_reaches_steady_desired():
+    result = bench.run_rung_external_queue()
+    assert result["replicas_reached"] == 3  # 240 queued / 100 per replica
+    assert 0 < result["spike_to_desired_s"] <= 60.0
+
+
+def test_multihost_quantum_rung_scales_on_slice_boundaries():
+    result = bench.run_rung_multihost_quantum()
+    assert result["replicas_reached"] == 8  # 4 slices x 2 hosts
+    assert result["slice_boundary_violations"] == 0
+    assert result["scale_up_s"] <= 120.0
+
+
+def test_pod_start_sweep_shows_budget_envelope():
+    """The actionable version of the reference's overshoot caveat
+    (README.md:123): the sweep must show WHERE the 60 s budget breaks."""
+    sweep = bench.run_pod_start_sweep()
+    assert [case["pod_start_s"] for case in sweep] == [12.0, 30.0, 60.0]
+    # monotone: slower pods, slower scale-up
+    latencies = [case["scale_up_s"] for case in sweep]
+    assert latencies == sorted(latencies)
+    assert sweep[0]["budget_pass"] is True  # GKE-realistic 12 s: holds
+    assert sweep[-1]["budget_pass"] is False  # 60 s pod start: budget lost
+    assert sweep[0]["overshoot"] == 0  # behavior stanza holds at low lag
+
+
+def test_phase_timeout_abandons_wedged_work():
+    import time
+
+    try:
+        bench.run_phase_with_timeout(
+            lambda: time.sleep(30), 0.5, "wedge", lambda m: None
+        )
+    except RuntimeError as e:
+        assert "wedged" in str(e)
+    else:
+        raise AssertionError("wedged phase must raise")
+
+
+def test_phase_timeout_propagates_inner_errors():
+    import pytest
+
+    with pytest.raises(ValueError, match="boom"):
+        bench.run_phase_with_timeout(
+            lambda: (_ for _ in ()).throw(ValueError("boom")), 5.0, "x", lambda m: None
+        )
