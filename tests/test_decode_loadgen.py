@@ -1,0 +1,95 @@
+"""The serve-rung workload: KV-cache decode load generator + request queue
+(loadgen/decode.py) — previously covered only indirectly through the
+transformer tests and the bench.
+
+The decode generator is the producer of two shipped autoscale signals: its
+queue depth feeds the External HPA (deploy/tpu-test-external-hpa.yaml) and
+its self-reported bandwidth feeds ``tpu_serve_hbm_bw_avg`` — so its
+accounting semantics are string contracts like everything else here.
+"""
+
+import time
+
+from k8s_gpu_hpa_tpu.loadgen.decode import DecodeLoadGen, RequestQueue
+
+
+def tiny_gen(**kw) -> DecodeLoadGen:
+    defaults = dict(
+        batch=2, max_seq=16, d_model=32, n_heads=2, n_layers=1, tokens_per_burst=2
+    )
+    defaults.update(kw)
+    return DecodeLoadGen(**defaults)
+
+
+# ---- request queue (the External-metric demand signal) ---------------------
+
+
+def test_queue_accumulates_and_serves():
+    q = RequestQueue()
+    q.offer(10.5)
+    assert q.depth == 10.5
+    assert q.take(4.0) == 4.0
+    assert q.depth == 6.5
+    # draining more than queued serves only what exists
+    assert q.take(100.0) == 6.5
+    assert q.depth == 0.0
+    assert q.offered_total == 10.5
+    assert q.served_total == 10.5
+
+
+def test_queue_bounds_and_rejects_negatives():
+    q = RequestQueue(max_depth=5.0)
+    q.offer(100.0)
+    assert q.depth == 5.0  # backpressure: bounded demand signal
+    q.offer(-3.0)  # a buggy rate can't drain the queue via offer()
+    assert q.depth == 5.0
+    assert q.take(-2.0) == 0.0
+
+
+# ---- decode generator accounting -------------------------------------------
+
+
+def test_decode_steps_and_token_accounting():
+    gen = tiny_gen()
+    gen.warmup()  # compile excluded from accounting
+    stats = gen.stats()
+    assert stats.steps == 0 and stats.tokens_generated == 0
+    for _ in range(3):
+        gen.step()
+    stats = gen.stats()
+    assert stats.steps == 3
+    # tokens = batch * tokens_per_burst * steps, exact by construction
+    assert stats.tokens_generated == 2 * 2 * 3
+    assert stats.tokens_per_sec > 0
+    assert stats.utilization_pct > 0
+
+
+def test_decode_cache_bytes_are_exact():
+    gen = tiny_gen()
+    stats = gen.stats()
+    # K and V per layer: batch x max_seq x d_model, bf16 (2 bytes)
+    expected = 1 * 2 * (2 * 16 * 32 * 2)
+    assert stats.cache_bytes == expected
+
+
+def test_decode_windowed_rates_decay_when_idle():
+    """An idle worker must decay to 0 within the window, or the serve HPA
+    would never see demand drop (decode.py's load-insensitivity note)."""
+    gen = tiny_gen(window=0.4)
+    gen.warmup()
+    for _ in range(3):
+        gen.step()
+    assert gen.stats().utilization_pct > 0
+    time.sleep(0.6)  # idle past the window
+    stats = gen.stats()
+    assert stats.utilization_pct == 0.0
+    assert stats.achieved_gbps == 0.0
+
+
+def test_decode_bw_pct_none_off_tpu():
+    # no public HBM peak for the cpu backend -> the gauge is absent, never 0
+    gen = tiny_gen()
+    gen.warmup()
+    gen.step()
+    if gen.peak_hbm_gbps is None:
+        assert gen.stats().hbm_bw_util_pct is None
