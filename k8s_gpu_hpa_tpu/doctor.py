@@ -165,6 +165,38 @@ def check_alerts(payload: str) -> str:
     return "no pipeline alerts firing"
 
 
+def check_operator_metrics(text: str) -> str:
+    """The quantum operator's self-report (its /metrics on the health port).
+    Serving the counter families proves the reconcile loop is alive and
+    observable; any ``partial_slice_held`` sample at 1 is itself a diagnosis
+    — stranded hosts running but serving nothing (the steady-hold rule,
+    control/operator.py) — with the fix in the TpuSliceHeldPartial alert's
+    annotation: make the HPA's replica bounds slice multiples."""
+    from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+
+    families = {f.name: f for f in parse_text(text)}
+    reconciles_fam = families.get("quantum_operator_reconciles_total")
+    if reconciles_fam is None or not reconciles_fam.samples:
+        raise AssertionError(
+            "no quantum_operator_reconciles_total sample served — not the "
+            "operator's /metrics endpoint, or a truncated scrape?"
+        )
+    reconciles = int(reconciles_fam.samples[0].value)
+    held_fam = families.get("quantum_operator_partial_slice_held")
+    held = [
+        dict(s.labels).get("target", "?")
+        for s in (held_fam.samples if held_fam is not None else [])
+        if s.value > 0
+    ]
+    if held:
+        raise AssertionError(
+            f"partial slice held on {', '.join(held)}: stranded hosts are "
+            "running but serving nothing — make the HPA's minReplicas/"
+            "maxReplicas slice multiples"
+        )
+    return f"operator alive ({reconciles} reconcile passes), no partial slice held"
+
+
 def diagnose(
     exporter_fetch: Callable[[], str] | None = None,
     prom_fetch: Callable[[], str] | None = None,
@@ -172,6 +204,7 @@ def diagnose(
     hpa_fetch: Callable[[], str] | None = None,
     metric: str = "tpu_test_tensorcore_avg",
     alerts_fetch: Callable[[], str] | None = None,
+    operator_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -200,6 +233,13 @@ def diagnose(
             "L5 HPA",
             "HPA is reading the metric (ScalingActive)",
             (lambda: check_hpa_status(hpa_fetch())) if hpa_fetch else None,
+        ),
+        (
+            "quantum operator",
+            "operator self-metrics live, no partial slice held",
+            (lambda: check_operator_metrics(operator_fetch()))
+            if operator_fetch
+            else None,
         ),
         (
             "alerts",
@@ -385,6 +425,14 @@ def main() -> int:
         ),
         metric=metric,
         alerts_fetch=lambda: _http_fetch(f"{prom_url}/api/v1/alerts"),
+        # optional: only deployed alongside multihost rungs — set e.g.
+        # OPERATOR_URL=http://localhost:8086/metrics after
+        # `kubectl port-forward deploy/quantum-operator 8086`
+        operator_fetch=(
+            (lambda: _http_fetch(os.environ["OPERATOR_URL"]))
+            if os.environ.get("OPERATOR_URL")
+            else None
+        ),
     )
     broken = False
     for r in results:

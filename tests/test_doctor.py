@@ -149,7 +149,8 @@ def test_diagnose_stops_at_first_broken_joint():
 
 def test_diagnose_skips_absent_fetchers():
     results = diagnose(exporter_fetch=lambda: exposition())
-    assert [r.ok for r in results] == [True, True, True, True, True]
+    # L2 + L3 + L4 + L5 + operator + alerts
+    assert [r.ok for r in results] == [True] * 6
     assert results[1].detail.startswith("skipped")
 
 
@@ -236,3 +237,66 @@ def test_probe_libtpu_flags_unmapped_advertised_names(capsys):
     assert "does not consume" in out
     # mapped names are not flagged
     assert f"{libtpu_proto.DUTY_CYCLE}  <- unmapped" not in out
+
+
+# ---- quantum operator probe -------------------------------------------------
+
+
+def test_check_operator_metrics_ok():
+    from k8s_gpu_hpa_tpu.control.operator import OperatorMetrics
+    from k8s_gpu_hpa_tpu.doctor import check_operator_metrics
+
+    metrics = OperatorMetrics()
+    metrics.reconciles_total = 7
+    metrics.set_held("StatefulSet/tpu-test-multihost", False)
+    detail = check_operator_metrics(metrics.render())
+    assert "7 reconcile passes" in detail
+
+
+def test_check_operator_metrics_flags_held_slice():
+    import pytest
+
+    from k8s_gpu_hpa_tpu.control.operator import OperatorMetrics
+    from k8s_gpu_hpa_tpu.doctor import check_operator_metrics
+
+    metrics = OperatorMetrics()
+    metrics.set_held("StatefulSet/tpu-test-multihost", True)
+    with pytest.raises(AssertionError, match="tpu-test-multihost"):
+        check_operator_metrics(metrics.render())
+
+
+def test_check_operator_metrics_rejects_wrong_endpoint():
+    import pytest
+
+    from k8s_gpu_hpa_tpu.doctor import check_operator_metrics
+
+    with pytest.raises(AssertionError, match="quantum_operator"):
+        check_operator_metrics("tpu_duty_cycle 5\n")
+
+
+def test_diagnose_includes_operator_probe():
+    from k8s_gpu_hpa_tpu.control.operator import OperatorMetrics
+    from k8s_gpu_hpa_tpu.doctor import diagnose
+
+    metrics = OperatorMetrics()
+    results = diagnose(operator_fetch=lambda: metrics.render())
+    by_name = {r.name: r for r in results}
+    assert by_name["quantum operator"].ok
+    # optional probe: skipped cleanly when no fetcher is given
+    results = diagnose()
+    assert "skipped" in {r.name: r for r in results}["quantum operator"].detail
+
+
+def test_check_operator_metrics_handles_truncated_scrape():
+    """A scrape cut after the TYPE line (family exists, no samples) and an
+    older image without the held gauge must both produce diagnoses, never a
+    raw IndexError or a false 'held on ?'."""
+    import pytest
+
+    from k8s_gpu_hpa_tpu.doctor import check_operator_metrics
+
+    with pytest.raises(AssertionError, match="truncated"):
+        check_operator_metrics("# TYPE quantum_operator_reconciles_total counter\n")
+    # held gauge family absent entirely (older operator): healthy, not held
+    detail = check_operator_metrics("quantum_operator_reconciles_total 5\n")
+    assert "no partial slice held" in detail
