@@ -48,7 +48,10 @@ where the time goes and what the pipeline does beyond the headline:
 - kernel: dwell-measured TFLOP/s — ONE long uninterrupted on-device chain
   of matmuls, wall-clock timed, no RTT correction and no clamp, so
   achieved < peak by construction (mfu_pct is the honest MFU) — plus the
-  same dwell through the Pallas kernel (the measured XLA-vs-Pallas gap).
+  same dwell through the Pallas kernel (the measured XLA-vs-Pallas gap),
+  and flash_attn: the fused Pallas flash-attention kernel vs the naive
+  XLA attention at a prefill shape (the owned-kernel win the plain matmul
+  cannot show; ops/flash_attention.py).
 - rungs: one measured result per BASELINE.json config.  Configs 1 (the
   headline), 2 (v5e-8 HBM Pods metric — REAL device allocations walk the
   per-pod hottest-chip HBM gauge across the 13Gi target) and 3 (ResNet-50
@@ -474,6 +477,50 @@ def measure_kernel_rates(gen: MatmulLoadGen, log) -> dict:
     except Exception as e:  # e.g. mosaic lowering failure
         log(f"kernel: pallas comparison skipped: {e}")
         out["pallas_tflops"] = None
+    return out
+
+
+def measure_attention_rates(log) -> dict | None:
+    """The owned-kernel-that-wins number: fused Pallas flash attention vs the
+    naive XLA path (ops/flash_attention.py) at a prefill-shaped causal
+    attention, same chained-dwell methodology as the matmul rates.  The naive
+    path materializes the [seq, seq] score matrix through HBM; the fused
+    kernel keeps it in VMEM — this measures that win on the real chip.
+    TPU-only (interpreter-mode Pallas timings would be meaningless)."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_hpa_tpu.ops.flash_attention import HAVE_PALLAS, flash_attention
+    from k8s_gpu_hpa_tpu.ops.ring_attention import reference_attention
+    from k8s_gpu_hpa_tpu.utils.dwell import chained_dwell_tflops
+
+    if jax.default_backend() != "tpu" or not HAVE_PALLAS:
+        log("attention: needs a real TPU + pallas; skipped")
+        return None
+    b, s, h, d = 2, 4096, 8, 128
+    iters = 100
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16) for kk in ks)
+    # causal effective FLOPs: two matmuls over the lower triangle.  The
+    # chain feeds out -> q: softmax output is a convex combination of V
+    # rows, so magnitudes stay bounded without renormalization.
+    flops = 4.0 * b * h * s * s * d * 0.5
+    flash = chained_dwell_tflops(
+        lambda x: flash_attention(x, k, v, causal=True), q, iters, flops
+    )
+    naive = chained_dwell_tflops(
+        lambda x: reference_attention(x, k, v, causal=True), q, iters, flops
+    )
+    out = {
+        "shape": f"b{b} h{h} s{s} d{d} causal bf16",
+        "flash_tflops": round(flash, 1),
+        "naive_xla_tflops": round(naive, 1),
+        "flash_vs_naive": round(flash / naive, 2),
+    }
+    log(
+        f"attention: flash {flash:.1f} TFLOP/s vs naive xla {naive:.1f} "
+        f"({out['flash_vs_naive']}x)"
+    )
     return out
 
 
@@ -1301,6 +1348,13 @@ def main() -> None:
             log(f"kernel measurement failed: {e}")
             kernel = {"error": str(e)}
         kernel["sustained_tflops_end_of_trials"] = round(trial_stats.sustained_tflops, 1)
+        try:
+            kernel["flash_attn"] = run_phase_with_timeout(
+                lambda: measure_attention_rates(log), 240.0, "attention rates", log
+            )
+        except Exception as e:
+            log(f"attention measurement failed: {e}")
+            kernel["flash_attn"] = {"error": str(e)}
         try:
             kernel["decode"] = run_phase_with_timeout(
                 lambda: measure_decode_rates(log), 240.0, "decode rates", log

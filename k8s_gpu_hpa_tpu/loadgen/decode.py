@@ -10,6 +10,13 @@ Greedy decode keeps everything on-device: the sampled token feeds the next
 step inside one ``lax.fori_loop`` dispatch (``tokens_per_burst`` steps per
 host round-trip, same dispatch-amortization as every other generator).
 
+``PREFILL_LEN > 0`` switches to the full serving shape: each burst admits a
+fresh request batch — prompt scored in one fused causal pass
+(``models/transformer.py::prefill``, riding the Pallas flash-attention
+kernel where the shape allows) — then decodes its continuation.  Prefill is
+MXU-bound, decode HBM-bound; a real serving pod runs both, which is why the
+serve rung's duty-cycle gauge and the bandwidth gauge move independently.
+
 Two self-reported signals feed the pipeline where device counters can't:
 
 - **achieved HBM bandwidth** — each decode token-step streams the full static
@@ -39,6 +46,7 @@ from k8s_gpu_hpa_tpu.models.transformer import (
     decode_step,
     init_kv_cache,
     init_params,
+    prefill,
 )
 
 
@@ -52,6 +60,11 @@ class DecodeStats:
     achieved_gbps: float  # bytes streamed / busy second
     hbm_bw_util_pct: float | None  # achieved/peak, None off-TPU
     utilization_pct: float  # busy fraction of wall time (duty cycle)
+    #: prompt tokens scored per busy second (0 unless prefill_len > 0).
+    #: With prefill in the burst the bandwidth numbers above become lower
+    #: bounds: prefill seconds land in the denominator, its bytes (weights
+    #: once + cache writes) are not added to the numerator.
+    prefill_tokens_per_sec: float = 0.0
 
 
 class RequestQueue:
@@ -104,8 +117,10 @@ class DecodeLoadGen:
         tokens_per_burst: int | None = None,
         dtype=jnp.bfloat16,
         window: float = 10.0,
+        prefill_len: int = 0,
     ):
         self.window = window
+        self.prefill_len = prefill_len
         self.cfg = TransformerConfig(
             d_model=d_model,
             n_heads=n_heads,
@@ -124,7 +139,7 @@ class DecodeLoadGen:
         self._pos = jnp.int32(0)
         cfg = self.cfg
 
-        def burst(params, tokens, cache, pos):
+        def decode_chain(params, tokens, cache, pos):
             def body(_, carry):
                 tokens, cache, pos = carry
                 logits, cache = decode_step(params, cfg, tokens, cache, pos)
@@ -133,10 +148,39 @@ class DecodeLoadGen:
                 # static cache (serving would evict/restart the sequence)
                 return nxt, cache, (pos + 1) % (cfg.max_seq - 1)
 
-            tokens, cache, pos = lax.fori_loop(
+            return lax.fori_loop(
                 0, self.tokens_per_burst, body, (tokens, cache, pos)
             )
-            return tokens, cache, pos
+
+        if prefill_len > 0:
+            # the real serving shape: each burst admits a fresh request batch
+            # (prefill the prompt with the fused causal pass — MXU-bound)
+            # then decodes from it (HBM-bound) — one dispatch for both phases
+            # ValueError, not assert: prefill_len arrives via PREFILL_LEN
+            # from the pod env, and an out-of-range value under python -O
+            # would silently clamp cache writes instead of failing
+            if prefill_len + tokens_per_burst >= max_seq:
+                raise ValueError(
+                    f"prefill_len {prefill_len} + tokens_per_burst "
+                    f"{tokens_per_burst} must stay inside max_seq {max_seq}"
+                )
+            self._prompt = jax.random.randint(
+                jax.random.PRNGKey(2), (batch, prefill_len), 0, self.cfg.vocab,
+                jnp.int32,
+            )
+
+            def burst(params, tokens, cache, _pos):
+                logits, cache = prefill(params, cfg, self._prompt, cache)
+                first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return decode_chain(
+                    params, first, cache, jnp.int32(prefill_len)
+                )
+
+        else:
+            self._prompt = None
+
+            def burst(params, tokens, cache, pos):
+                return decode_chain(params, tokens, cache, pos)
 
         self._burst = jax.jit(burst)
         self._steps = 0
@@ -208,6 +252,7 @@ class DecodeLoadGen:
             if self.peak_hbm_gbps
             else None
         )
+        prefill_tokens = self.batch * self.prefill_len * self._steps
         return DecodeStats(
             steps=self._steps,
             tokens_generated=tokens,
@@ -217,6 +262,9 @@ class DecodeLoadGen:
             achieved_gbps=achieved_gbps,
             hbm_bw_util_pct=bw_pct,
             utilization_pct=min(100.0, 100.0 * win_busy / wall),
+            prefill_tokens_per_sec=(
+                prefill_tokens / self._busy if self._busy else 0.0
+            ),
         )
 
 
@@ -224,7 +272,9 @@ def main() -> None:
     """``WORKLOAD=decode python -m k8s_gpu_hpa_tpu.loadgen`` — the serving
     container shape: offered-load generator → request queue → decode worker.
 
-    Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_LAYERS, OFFERED_RPS_MAX (offered
+    Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_LAYERS, PREFILL_LEN (tokens of
+    prompt scored per burst via the fused prefill pass; 0 = decode-only,
+    the default), OFFERED_RPS_MAX (offered
     load at knob=1.0; default 4× one worker's measured capacity so cranking
     the knob genuinely outruns one pod and drives the External rung), plus
     the standard intensity knob (TPU_TEST_INTENSITY / the watched file) now
@@ -242,6 +292,7 @@ def main() -> None:
         max_seq=int(os.environ.get("MAX_SEQ", "2048")),
         d_model=int(os.environ.get("D_MODEL", "512")),
         n_layers=int(os.environ.get("N_LAYERS", "4")),
+        prefill_len=int(os.environ.get("PREFILL_LEN", "0")),
     )
     gen.warmup()
     knob = IntensityKnob()

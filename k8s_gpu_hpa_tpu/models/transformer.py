@@ -201,6 +201,70 @@ def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
     }
 
 
+def prefill(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [batch, prompt_len] int32 — the whole prompt
+    cache: dict,
+) -> tuple[jax.Array, dict]:
+    """Single-device prompt scoring: one fused causal pass over the prompt,
+    filling the KV cache for positions ``0..prompt_len-1`` and returning the
+    logits at the LAST prompt position (what greedy decode continues from,
+    at ``pos = prompt_len``).
+
+    The serving shape the reference never had: prefill is MXU-bound (big
+    batched attention + MLPs over the whole prompt) where decode is
+    HBM-bound — a real serving pod runs both.  The attention hot op is the
+    fused Pallas flash kernel (ops/flash_attention.py) whenever the shape
+    sits in its envelope (MXU-aligned head_dim, block-divisible prompt),
+    falling back to the exact XLA path otherwise — callers never branch.
+
+    Equivalence with the incremental path is pinned by
+    tests/test_transformer.py: prefill(prompt) must match feeding the same
+    tokens through ``decode_step`` one position at a time, logits and cache.
+    """
+    from k8s_gpu_hpa_tpu.ops.flash_attention import flash_attention
+
+    b, plen = tokens.shape
+    pos = jnp.arange(plen)
+    x = params["embed"][tokens] + params["pos"][pos][None, :, :].astype(cfg.dtype)
+    new_k, new_v = [], []
+    for i, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["attn_norm"])
+        qkv = jnp.einsum(
+            "bsd,de->bse", h, blk["wqkv"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, plen, cfg.n_heads, cfg.head_dim)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        attn = flash_attention(q, k, v, causal=True).reshape(b, plen, cfg.d_model)
+        # static-position cache fill (prompt length is a static shape)
+        new_k.append(
+            lax.dynamic_update_slice(cache["k"][i], k, (0, 0, 0, 0))
+        )
+        new_v.append(
+            lax.dynamic_update_slice(cache["v"][i], v, (0, 0, 0, 0))
+        )
+        x = x + jnp.einsum(
+            "bsd,de->bse", attn, blk["wo"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        h = _rmsnorm(x, blk["mlp_norm"])
+        up = jnp.einsum(
+            "bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32
+        )
+        x = x + jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(up).astype(cfg.dtype),
+            blk["w2"],
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+    x = _rmsnorm(x[:, -1:], params["out_norm"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )[:, 0]
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
 def decode_step(
     params: dict,
     cfg: TransformerConfig,

@@ -1,3 +1,12 @@
+from k8s_gpu_hpa_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_supported,
+)
 from k8s_gpu_hpa_tpu.ops.pallas_matmul import matmul, matmul_pallas
 
-__all__ = ["matmul", "matmul_pallas"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_supported",
+    "matmul",
+    "matmul_pallas",
+]

@@ -1,0 +1,190 @@
+"""Fused flash attention as a Pallas TPU kernel — the owned kernel that wins.
+
+Where the plain-matmul sweep showed XLA's emitter is unbeatable on its home
+turf (ops/pallas_matmul.py, tools/pallas_autotune.py), attention is the
+opposite case: XLA materializes the [seq, seq] score matrix through HBM
+(softmax is a data dependence it cannot rewrite away), while a fused kernel
+keeps scores in VMEM and streams them through the online-softmax
+recurrence — the memory-hierarchy win kernels exist for.  This is the
+single-chip prefill/scoring hot op for long-context serving; the
+sequence-PARALLEL axis (KV streamed chip-to-chip over ICI) is
+ops/ring_attention.py, which uses the same online-softmax algebra at the
+mesh scale.
+
+Kernel design (v5e-first):
+- Layout [b*h, seq, d]; grid (b*h, seq/block_q), both axes parallel — no
+  cross-step scratch carries, no revisiting.
+- The whole K/V stripe for one batch-head rides into VMEM with the grid
+  step (seq * d * 2 B each — 1 MiB at 4k x 128, far under the ~100 MiB
+  budget; the 12 MiB stripe guard admits ~49k tokens bf16 / ~24k f32 at
+  d=128), so the inner ``lax.fori_loop`` over KV chunks reads VMEM, never
+  HBM.
+- Online softmax in f32: running (m, l, acc) per Q row; probabilities cast
+  back to the operand dtype for the P @ V matmul (MXU-native bf16).
+- Causal masking per chunk via 2-D iota, and fully-masked future chunks are
+  not merely masked but SKIPPED: the loop bound for Q block i is
+  ceil((i+1) * block_q / block_k) — the triangular-work saving a masked
+  dense kernel cannot get.
+
+The reference has no attention op at all (SURVEY.md §2c: no model code);
+this op serves the rebuild's beyond-reference long-context story.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # same backend-sensitivity gate as ops/pallas_matmul.py
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+NEG_INF = -1e30  # matches ring_attention.py: large-negative beats -inf in exp math
+
+#: K + V stripes for one batch-head must fit the VMEM budget with headroom
+#: (2 * seq * head_dim * itemsize); 12 MiB each keeps double-buffering room.
+_STRIPE_BYTES_MAX = 12 * 1024 * 1024
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool):
+    """One (batch-head, Q block) grid step over the full resident KV stripe."""
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    seq = k_ref.shape[1]
+    n_chunks = seq // block_k
+    iq = pl.program_id(1)
+    q = q_ref[0]  # [bq, d], operand dtype
+    scale = 1.0 / (d ** 0.5)
+
+    def chunk(j, carry):
+        m, l, acc = carry
+        kc = k_ref[0, pl.ds(j * block_k, block_k), :]  # [bk, d]
+        vc = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(
+            q, kc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [bq, 1]
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.dot(
+            p.astype(q_ref.dtype), vc, preferred_element_type=jnp.float32
+        )  # [bq, d]
+        acc = acc * corr + pv
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    # causal: Q block i never attends past position (i+1)*bq - 1, so chunks
+    # from ceil((i+1)*bq / bk) on are ALL-masked — skip them (dynamic bound)
+    hi = (
+        jnp.minimum(n_chunks, ((iq + 1) * bq + block_k - 1) // block_k)
+        if causal
+        else n_chunks
+    )
+    m, l, acc = lax.fori_loop(0, hi, chunk, (m0, l0, acc0))
+    # causal rows always attend to their own position, so l > 0; the floor
+    # only guards a hypothetical all-masked row (same note as ring_attention)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
+    """Pallas call on [b*h, seq, d] operands."""
+    bh, seq, d = q.shape
+    interpret = jax.default_backend() != "tpu"
+    try:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        )
+    except Exception:  # pragma: no cover
+        params = None
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        grid=(bh, seq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, seq, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda bh, iq: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _fit_block(seq: int, want: int) -> int | None:
+    """Largest block <= ``want`` that divides ``seq`` (tile-aligned candidates
+    only), so short prompts ride the kernel with shrunken blocks instead of
+    falling back."""
+    for b in (want, 512, 256, 128, 64):
+        if b <= want and b <= seq and seq % b == 0:
+            return b
+    return None
+
+
+def flash_attention_supported(
+    q: jax.Array, block_q: int = 512, block_k: int = 512
+) -> bool:
+    """Shape envelope for the fused kernel: MXU-aligned head_dim, a sequence
+    some block size <= the requested one divides, KV stripe within the VMEM
+    budget."""
+    if not HAVE_PALLAS or q.ndim != 4:
+        return False
+    _, seq, _, d = q.shape
+    stripe = seq * d * jnp.dtype(q.dtype).itemsize
+    return (
+        d % 128 == 0
+        and _fit_block(seq, block_q) is not None
+        and _fit_block(seq, block_k) is not None
+        and stripe <= _STRIPE_BYTES_MAX
+    )
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Fused exact attention, [batch, seq, heads, head_dim] in and out (the
+    repo's layout, same as ring_attention/reference_attention).  Forward-only
+    (no custom VJP): this is the inference/prefill hot op — training paths
+    use the autodiff-friendly XLA blocking in ops/ring_attention.py.
+
+    Falls back to the naive XLA path off the supported envelope (unaligned
+    shapes, cross-attention with lk != lq, no Pallas) so callers never
+    branch.
+    """
+    if q.shape != k.shape or q.shape != v.shape or not flash_attention_supported(
+        q, block_q, block_k
+    ):
+        from k8s_gpu_hpa_tpu.ops.ring_attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal)
+    b, s, h, d = q.shape
+    block_q = _fit_block(s, block_q)
+    block_k = _fit_block(s, block_k)
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k
+    )
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -156,6 +156,75 @@ def test_decode_matches_teacher_forced_forward():
         )
 
 
+def test_prefill_matches_incremental_decode():
+    """Serving parity: one fused prefill pass over the prompt must equal
+    feeding the same tokens through decode_step position by position — same
+    final logits, same KV cache over the prompt span."""
+    from k8s_gpu_hpa_tpu.models.transformer import (
+        decode_step,
+        init_kv_cache,
+        prefill,
+    )
+
+    cfg = CFG
+    plen = 16
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tokens_for(cfg, batch=2)[:, :plen]
+
+    got_logits, got_cache = jax.jit(lambda p, t, c: prefill(p, cfg, t, c))(
+        params, prompt, init_kv_cache(cfg, batch=2)
+    )
+
+    cache = init_kv_cache(cfg, batch=2)
+    step = jax.jit(lambda p, t, c, pos: decode_step(p, cfg, t, c, pos))
+    for pos in range(plen):
+        want_logits, cache = step(params, prompt[:, pos], cache, jnp.int32(pos))
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    for side in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(got_cache[side][:, :, :plen]),
+            np.asarray(cache[side][:, :, :plen]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+        # beyond the prompt the cache is untouched (zeros from init)
+        assert not np.asarray(got_cache[side][:, :, plen:]).any()
+
+
+def test_prefill_uses_flash_envelope_shapes():
+    """A head_dim-128, block-divisible prompt rides the fused Pallas kernel
+    (interpreter mode here) and must still match incremental decode."""
+    from k8s_gpu_hpa_tpu.models.transformer import (
+        decode_step,
+        init_kv_cache,
+        prefill,
+    )
+    from k8s_gpu_hpa_tpu.ops.flash_attention import flash_attention_supported
+
+    cfg = TransformerConfig(
+        d_model=256, n_heads=2, n_layers=1, d_ff=256, max_seq=128, dtype=jnp.float32
+    )
+    plen = 128
+    probe = jnp.zeros((2, plen, cfg.n_heads, cfg.head_dim), cfg.dtype)
+    # block fitting shrinks the default 512 blocks to this 128-token prompt,
+    # so prefill's internal default-block call genuinely rides the kernel
+    assert flash_attention_supported(probe)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    prompt = tokens_for(cfg, batch=2, seed=5)[:, :plen]
+    got_logits, _ = jax.jit(lambda p, t, c: prefill(p, cfg, t, c))(
+        params, prompt, init_kv_cache(cfg, batch=2)
+    )
+    cache = init_kv_cache(cfg, batch=2)
+    step = jax.jit(lambda p, t, c, pos: decode_step(p, cfg, t, c, pos))
+    for pos in range(plen):
+        want_logits, cache = step(params, prompt[:, pos], cache, jnp.int32(pos))
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_decode_loadgen_generates():
     from k8s_gpu_hpa_tpu.loadgen.decode import DecodeLoadGen
 

@@ -42,17 +42,16 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 import k8s_gpu_hpa_tpu.ops.pallas_matmul as pm
 from k8s_gpu_hpa_tpu.loadgen.matmul import peak_tflops_for
+from k8s_gpu_hpa_tpu.utils.dwell import chained_dwell_tflops
 
 
 def candidate_configs(size: int) -> list[tuple[str, dict]]:
@@ -80,27 +79,17 @@ def candidate_configs(size: int) -> list[tuple[str, dict]]:
 
 
 def make_dwell(size: int, op):
-    """Chained-dwell timer: same shape as MatmulLoadGen.measure_dwell_tflops."""
+    """Chained-dwell timer (utils/dwell.py — same methodology as the bench
+    and MatmulLoadGen.measure_dwell_tflops) over normalized matmul chains."""
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (size, size), jnp.bfloat16)
     b = jax.random.normal(jax.random.fold_in(key, 1), (size, size), jnp.bfloat16)
     scale = jnp.bfloat16(1.0 / (size ** 0.5))
 
-    def burst(a, b, n):
-        def body(_, x):
-            return op(x, b) * scale
-
-        out = lax.fori_loop(0, n, body, a)
-        return out.ravel()[0].astype(jnp.float32)
-
-    jit_burst = jax.jit(burst)
-
     def dwell(iters: int) -> float:
-        float(jit_burst(a, b, jnp.int32(2)))  # compile
-        t0 = time.perf_counter()
-        float(jit_burst(a, b, jnp.int32(iters)))
-        wall = time.perf_counter() - t0
-        return 2.0 * size**3 * iters / wall / 1e12
+        return chained_dwell_tflops(
+            lambda x: op(x, b) * scale, a, iters, 2.0 * size**3
+        )
 
     return dwell
 
