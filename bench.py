@@ -498,7 +498,9 @@ def measure_attention_rates(log) -> dict | None:
         log("attention: needs a real TPU + pallas; skipped")
         return None
     b, s, h, d = 2, 4096, 8, 128
-    iters = 100
+    # >= 1 s of dwell: 100-iter flash dwells (~0.3 s) under-read by up to 2x
+    # (dispatch/warm-up effects; measured 48 vs 80 TFLOP/s at 100 vs 400)
+    iters = 400
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
     q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16) for kk in ks)
     # causal effective FLOPs: two matmuls over the lower triangle.  The

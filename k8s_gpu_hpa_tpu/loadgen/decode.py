@@ -272,9 +272,9 @@ def main() -> None:
     """``WORKLOAD=decode python -m k8s_gpu_hpa_tpu.loadgen`` — the serving
     container shape: offered-load generator → request queue → decode worker.
 
-    Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_LAYERS, PREFILL_LEN (tokens of
-    prompt scored per burst via the fused prefill pass; 0 = decode-only,
-    the default), OFFERED_RPS_MAX (offered
+    Env: DECODE_BATCH, MAX_SEQ, D_MODEL, N_HEADS, N_LAYERS, PREFILL_LEN
+    (tokens of prompt scored per burst via the fused prefill pass; 0 =
+    decode-only, the default), OFFERED_RPS_MAX (offered
     load at knob=1.0; default 4× one worker's measured capacity so cranking
     the knob genuinely outruns one pod and drives the External rung), plus
     the standard intensity knob (TPU_TEST_INTENSITY / the watched file) now
@@ -291,6 +291,11 @@ def main() -> None:
         batch=int(os.environ.get("DECODE_BATCH", "8")),
         max_seq=int(os.environ.get("MAX_SEQ", "2048")),
         d_model=int(os.environ.get("D_MODEL", "512")),
+        # the fused prefill kernel needs head_dim % 128 == 0
+        # (ops/flash_attention.py envelope): N_HEADS=4 at the default
+        # D_MODEL=512 gives head_dim 128; the default 8 (head_dim 64)
+        # prefills via the exact XLA fallback instead
+        n_heads=int(os.environ.get("N_HEADS", "8")),
         n_layers=int(os.environ.get("N_LAYERS", "4")),
         prefill_len=int(os.environ.get("PREFILL_LEN", "0")),
     )

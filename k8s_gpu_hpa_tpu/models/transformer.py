@@ -77,6 +77,35 @@ def _rmsnorm(x, g):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
 
 
+def _block_forward(x, blk, cfg: TransformerConfig, attn_fn):
+    """One transformer block over a full sequence — the single definition of
+    the norm/qkv/attention/wo/MLP structure shared by the training forward
+    (ring attention) and the serving prefill (flash attention); only the
+    attention op differs.  ``attn_fn([b,s,h,d] q, k, v) -> [b,s,h,d]``.
+    Returns (x, k, v) so cache-filling callers keep the projected KV."""
+    b, lq, _ = x.shape
+    h = _rmsnorm(x, blk["attn_norm"])
+    qkv = jnp.einsum(
+        "bsd,de->bse", h, blk["wqkv"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, lq, cfg.n_heads, cfg.head_dim)
+    q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+    attn = attn_fn(q, k, v).reshape(b, lq, cfg.d_model)
+    x = x + jnp.einsum(
+        "bsd,de->bse", attn, blk["wo"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    h = _rmsnorm(x, blk["mlp_norm"])
+    up = jnp.einsum("bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32)
+    x = x + jnp.einsum(
+        "bsf,fd->bsd",
+        jax.nn.gelu(up).astype(cfg.dtype),
+        blk["w2"],
+        preferred_element_type=jnp.float32,
+    ).astype(cfg.dtype)
+    return x, k, v
+
+
 def forward_local(
     params: dict,
     tokens: jax.Array,  # [batch, local_seq] int32, this device's shard
@@ -97,28 +126,12 @@ def forward_local(
     # [b, lq, d_ff] residuals live, which is what bounds context length
     @jax.checkpoint
     def block(x, blk):
-        h = _rmsnorm(x, blk["attn_norm"])
-        qkv = jnp.einsum(
-            "bsd,de->bse", h, blk["wqkv"], preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, lq, cfg.n_heads, cfg.head_dim)
-        attn = ring_attention_local(
-            q.reshape(shape), k.reshape(shape), v.reshape(shape), axis, n, causal=True
-        ).reshape(b, lq, cfg.d_model)
-        x = x + jnp.einsum(
-            "bsd,de->bse", attn, blk["wo"], preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
-        h = _rmsnorm(x, blk["mlp_norm"])
-        up = jnp.einsum(
-            "bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32
+        x, _, _ = _block_forward(
+            x,
+            blk,
+            cfg,
+            lambda q, k, v: ring_attention_local(q, k, v, axis, n, causal=True),
         )
-        x = x + jnp.einsum(
-            "bsf,fd->bsd",
-            jax.nn.gelu(up).astype(cfg.dtype),
-            blk["w2"],
-            preferred_element_type=jnp.float32,
-        ).astype(cfg.dtype)
         return x
 
     for blk in params["blocks"]:
@@ -230,34 +243,12 @@ def prefill(
     x = params["embed"][tokens] + params["pos"][pos][None, :, :].astype(cfg.dtype)
     new_k, new_v = [], []
     for i, blk in enumerate(params["blocks"]):
-        h = _rmsnorm(x, blk["attn_norm"])
-        qkv = jnp.einsum(
-            "bsd,de->bse", h, blk["wqkv"], preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, plen, cfg.n_heads, cfg.head_dim)
-        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
-        attn = flash_attention(q, k, v, causal=True).reshape(b, plen, cfg.d_model)
+        x, k, v = _block_forward(
+            x, blk, cfg, lambda q, k, v: flash_attention(q, k, v, causal=True)
+        )
         # static-position cache fill (prompt length is a static shape)
-        new_k.append(
-            lax.dynamic_update_slice(cache["k"][i], k, (0, 0, 0, 0))
-        )
-        new_v.append(
-            lax.dynamic_update_slice(cache["v"][i], v, (0, 0, 0, 0))
-        )
-        x = x + jnp.einsum(
-            "bsd,de->bse", attn, blk["wo"], preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
-        h = _rmsnorm(x, blk["mlp_norm"])
-        up = jnp.einsum(
-            "bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32
-        )
-        x = x + jnp.einsum(
-            "bsf,fd->bsd",
-            jax.nn.gelu(up).astype(cfg.dtype),
-            blk["w2"],
-            preferred_element_type=jnp.float32,
-        ).astype(cfg.dtype)
+        new_k.append(lax.dynamic_update_slice(cache["k"][i], k, (0, 0, 0, 0)))
+        new_v.append(lax.dynamic_update_slice(cache["v"][i], v, (0, 0, 0, 0)))
     x = _rmsnorm(x[:, -1:], params["out_norm"])
     logits = jnp.einsum(
         "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32

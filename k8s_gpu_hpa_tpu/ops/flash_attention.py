@@ -13,7 +13,10 @@ mesh scale.
 
 Kernel design (v5e-first):
 - Layout [b*h, seq, d]; grid (b*h, seq/block_q), both axes parallel — no
-  cross-step scratch carries, no revisiting.
+  cross-step scratch carries, no revisiting.  512x512 blocks measured best
+  on v5e (~80 TFLOP/s effective at b2 h8 s4096 d128 causal bf16 over a
+  >=1 s dwell, vs ~76 at 1024x1024; short dwells under-read by 2x — see
+  utils/dwell.py for the methodology).
 - The whole K/V stripe for one batch-head rides into VMEM with the grid
   step (seq * d * 2 B each — 1 MiB at 4k x 128, far under the ~100 MiB
   budget; the 12 MiB stripe guard admits ~49k tokens bf16 / ~24k f32 at
@@ -127,11 +130,13 @@ def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
 
 
 def _fit_block(seq: int, want: int) -> int | None:
-    """Largest block <= ``want`` that divides ``seq`` (tile-aligned candidates
-    only), so short prompts ride the kernel with shrunken blocks instead of
-    falling back."""
-    for b in (want, 512, 256, 128, 64):
-        if b <= want and b <= seq and seq % b == 0:
+    """Largest block <= ``want`` that divides ``seq``, tile-aligned candidates
+    only (multiples of 64 cover the bf16/f32 sublane tiles — a requested
+    block that is NOT aligned is rejected here so it falls back instead of
+    failing Mosaic lowering), so short prompts ride the kernel with shrunken
+    blocks instead of falling back."""
+    for b in (want, 1024, 512, 256, 128, 64):
+        if b % 64 == 0 and b <= want and b <= seq and seq % b == 0:
             return b
     return None
 

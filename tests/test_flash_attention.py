@@ -67,6 +67,11 @@ def test_supported_envelope():
     # no tile-aligned divisor at all: unsupported (falls back)
     odd = jnp.zeros((1, 96, 2, 128), jnp.float32)
     assert not flash_attention_supported(odd)
+    # a requested block that divides seq but is not sublane-tile-aligned is
+    # rejected (Mosaic would fail lowering): falls back instead of crashing
+    seq192 = jnp.zeros((1, 192, 2, 128), jnp.float32)
+    assert not flash_attention_supported(seq192, block_q=24, block_k=24)
+    assert flash_attention_supported(seq192, block_q=64, block_k=64)
     # a KV stripe beyond the VMEM budget is rejected: 64k x 128 x 4B = 32 MiB
     big = jnp.zeros((1, 65536, 1, 128), jnp.float32)
     assert not flash_attention_supported(big, block_q=512, block_k=512)
