@@ -65,6 +65,13 @@ where the time goes and what the pipeline does beyond the headline:
   {12, 30, 60} s — at which pod-start latency the 60 s budget fails, and
   whether the behavior stanza still holds overshoot at 0 at 60 s lag (the
   actionable version of the reference's overshoot caveat, README.md:123).
+
+Unattended resilience: every device-touching phase runs under an
+abandonable timeout (run_phase_with_timeout), and the load generator runs
+under a watchdog (SupervisedGen) — a wedged tunnel dispatch costs one
+watchdog period and one abandoned thread, never a fake utilization spike
+(the stall's return records into a generator no reader sees) and never a
+permanently-starved later phase.
 """
 
 from __future__ import annotations
@@ -296,7 +303,10 @@ def run_trial(gen: MatmulLoadGen, daemon: ExporterDaemon, log) -> dict:
             # unambiguous 1 and the measurement is the behavior stanza's own
             # pace (stabilization window + policy ramp), not metric noise.
             t_drop = clock.now()
-            down_deadline = clock.now() + 360.0
+            # generous drain bound: a tunnel stall mid-drain can extend the
+            # configured 120 s window + two ramp periods well past 360 s;
+            # an uncompleted drain costs the trial its scale-down sample
+            down_deadline = clock.now() + 600.0
             offered = 0.08
             log(f"  scale-up done in {t_done - t_cross:.1f}s; dropping load")
         if t_drop is not None and t_down_done is None and deployment.replicas == 1:
@@ -376,6 +386,113 @@ def run_overshoot_probe(gen: MatmulLoadGen, daemon: ExporterDaemon, log) -> int:
 
 
 # ---- wedged-tunnel containment ---------------------------------------------
+
+
+class SupervisedGen:
+    """The bench's load generator with wedge containment at the SOURCE.
+
+    The device tunnel can wedge mid-dispatch for minutes.  Two distinct
+    poisons follow if the generator is a bare thread (both observed in
+    unattended runs): (a) every later phase reads 0% utilization forever
+    because the one generator thread is blocked (the overshoot probe then
+    times out), and (b) when the stall finally returns, its whole duration
+    is recorded as one giant busy burst — a fake ~100% utilization spike
+    that upscales the HPA during a drain and reads as a flap the real
+    pipeline never had.
+
+    Containment: step() runs in a supervised worker; if no step completes
+    within ``watchdog_s``, the worker is ABANDONED (left blocked on the
+    wedged dispatch, same pattern as run_phase_with_timeout) and a fresh
+    generator takes over.  The stall's eventual return records into the
+    abandoned generator that no reader sees.  Readers always access the
+    current generator through this facade (attribute access forwards).
+    """
+
+    def __init__(self, factory, log, watchdog_s: float = 20.0):
+        # healthy steps complete sub-second at any intensity (burst <= 0.2 s
+        # + duty-cycle sleep), so 20 s cleanly separates wedge from jitter
+        # while bounding how long a stall can poison readers
+        self._factory = factory
+        self._log = log
+        self.watchdog_s = watchdog_s
+        self._gen = factory()
+        self._intensity = self._gen.intensity
+        self._epoch = 0
+        self._last_step = time.perf_counter()
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self._spawn_worker()
+        threading.Thread(target=self._watch, daemon=True, name="gen-watchdog").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---- reader/controller surface (forward to the current generator) ------
+
+    def __getattr__(self, name):
+        # object.__getattribute__ avoids recursing through this hook if
+        # _gen itself is missing (e.g. factory raised during __init__)
+        return getattr(object.__getattribute__(self, "_gen"), name)
+
+    def set_intensity(self, value: float) -> None:
+        self._intensity = value
+        self._gen.set_intensity(value)
+
+    def utilization(self, chip_index: int = 0) -> float:
+        return self._gen.utilization(chip_index)
+
+    def mxu_utilization(self):
+        return self._gen.mxu_utilization()
+
+    def stats(self):
+        return self._gen.stats()
+
+    # ---- supervision --------------------------------------------------------
+
+    def _spawn_worker(self) -> None:
+        epoch, gen = self._epoch, self._gen
+
+        def work():
+            while not self._stop.is_set() and self._epoch == epoch:
+                try:
+                    gen.step()
+                    # epoch guard: an ABANDONED worker's stalled step finally
+                    # returning must not refresh the heartbeat — it would
+                    # mask a concurrent wedge of the replacement generator
+                    if self._epoch == epoch:
+                        self._last_step = time.perf_counter()
+                except Exception as e:
+                    self._log(
+                        f"loadgen step failed ({type(e).__name__}: {e}); retrying"
+                    )
+                    time.sleep(1.0)
+
+        threading.Thread(target=work, daemon=True, name=f"loadgen-{epoch}").start()
+
+    def _watch(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(min(1.0, self.watchdog_s / 4))
+            if time.perf_counter() - self._last_step <= self.watchdog_s:
+                continue
+            self._log(
+                f"generator wedged (no step in {self.watchdog_s:.0f}s); "
+                f"abandoning worker, building a fresh generator"
+            )
+            self._epoch += 1  # current worker exits at its next loop check
+            try:
+                # the factory carries its own phase timeout (main wraps
+                # make_gen in run_phase_with_timeout), so a wedged rebuild
+                # raises here instead of blocking the watchdog
+                fresh = self._factory()
+            except Exception as e:
+                self._log(f"generator rebuild failed ({e}); will retry")
+                self._last_step = time.perf_counter()  # back off one period
+                continue
+            fresh.set_intensity(self._intensity)
+            self._gen = fresh
+            self._last_step = time.perf_counter()
+            self._spawn_worker()
 
 
 def run_phase_with_timeout(fn, seconds: float, label: str, log):
@@ -1241,22 +1358,26 @@ def main() -> None:
     backend = run_phase_with_timeout(detect_backend, 120.0, "backend init", log)
     size = 4096 if backend == "tpu" else 512
     log(f"bench: backend={backend}, matmul size={size}")
-    gen = MatmulLoadGen(size=size, intensity=0.2, window=3.0)
-    # don't let a stray intensity file override the commanded duty cycle
-    gen.intensity_file = f"/tmp/bench-intensity-{id(gen)}"
 
-    def warm():
-        gen.warmup()
-        if gen.peak_tflops is None:
+    def make_gen() -> MatmulLoadGen:
+        g = MatmulLoadGen(size=size, intensity=0.2, window=3.0)
+        # don't let a stray intensity file override the commanded duty cycle
+        g.intensity_file = f"/tmp/bench-intensity-{id(g)}"
+        g.warmup()
+        if g.peak_tflops is None:
             # CPU smoke fallback: no public peak for this backend —
             # calibrate a synthetic one from a full-tilt burst so the
             # tensorcore series exists and tracks duty cycle
-            gen.step()
-            gen.peak_tflops = max(gen.stats().achieved_tflops, 1e-9)
+            g.step()
+            g.peak_tflops = max(g.stats().achieved_tflops, 1e-9)
+        return g
 
-    # a tunnel wedge during warmup means nothing real can be measured:
-    # fail fast with a clear error instead of hanging unattended
-    run_phase_with_timeout(warm, 240.0, "warmup", log)
+    # a tunnel wedge during warmup means nothing real can be measured: fail
+    # fast with a clear error instead of hanging unattended.  Later wedges
+    # are SupervisedGen's job (abandon the worker, rebuild from this factory).
+    gen = SupervisedGen(
+        lambda: run_phase_with_timeout(make_gen, 240.0, "warmup", log), log
+    )
     # duty cycle (busy fraction) and genuine MXU rate, distinct by design
     source = JaxDeviceSource(
         util_fn=lambda i: gen.utilization(),
@@ -1270,20 +1391,11 @@ def main() -> None:
         port=0,
     )
 
-    # background threads: the load generator runs continuously (as it would in
-    # its own pod), and a feeder keeps the exporter fed with fresh sweeps
+    # background threads: the load generator runs continuously under its
+    # watchdog (as it would in its own pod), and a feeder keeps the exporter
+    # fed with fresh sweeps
     stop = threading.Event()
-
-    def generate():
-        while not stop.is_set():
-            try:
-                gen.step()
-            except Exception as e:
-                # a transiently wedged device tunnel must not silently kill
-                # the generator thread (every later trial would read 0.0
-                # utilization and time out); log, back off, retry
-                log(f"loadgen step failed ({type(e).__name__}: {e}); retrying")
-                time.sleep(1.0)
+    gen.start()
 
     def feed():
         while not stop.is_set():
@@ -1293,10 +1405,7 @@ def main() -> None:
                 log(f"exporter feed failed ({type(e).__name__}: {e}); retrying")
             time.sleep(0.5)
 
-    threads = [
-        threading.Thread(target=generate, daemon=True),
-        threading.Thread(target=feed, daemon=True),
-    ]
+    threads = [threading.Thread(target=feed, daemon=True)]
     for t in threads:
         t.start()
 
@@ -1450,6 +1559,7 @@ def main() -> None:
         # join the worker threads BEFORE tearing down the native exporter:
         # a feed() mid-push on a destroyed handle aborts the process
         stop.set()
+        gen.stop()
         gen.set_intensity(0.0)
         for t in threads:
             t.join(timeout=10.0)

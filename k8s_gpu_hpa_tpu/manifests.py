@@ -662,7 +662,14 @@ def _tpu_serve_deployment() -> dict:
             "DECODE_BATCH": "8",
             "MAX_SEQ": "2048",
             "D_MODEL": "512",
+            # head_dim 128 (512/4): inside the fused flash-attention
+            # envelope, so the prefill pass rides the Pallas kernel
+            # (ops/flash_attention.py) instead of the XLA fallback
+            "N_HEADS": "4",
             "N_LAYERS": "4",
+            # the full serving shape: each admitted request batch scores a
+            # 512-token prompt (MXU-bound prefill) then decodes (HBM-bound)
+            "PREFILL_LEN": "512",
             "TPU_TEST_INTENSITY": "1.0",
             "TPU_TEST_INTENSITY_FILE": INTENSITY_FILE,
         },
