@@ -479,3 +479,32 @@ def test_shipped_external_hpa_scales_on_queue_depth():
             hpa.sync_once()
         clock.advance(1.0)
     assert target.replicas == 1
+
+
+def test_shipped_serve_env_sits_inside_flash_envelope():
+    """The serve Deployment promises the fused prefill kernel (its header
+    comment and README): the env numbers must actually satisfy the kernel's
+    shape envelope — head_dim MXU-aligned, prompt block-divisible, prompt +
+    decode burst inside the static cache.  A drive-by D_MODEL/N_HEADS edit
+    that silently demotes every prefill to the XLA fallback fails here."""
+    import jax.numpy as jnp
+
+    from k8s_gpu_hpa_tpu.ops.flash_attention import flash_attention_supported
+
+    doc = load("tpu-serve-deployment.yaml")
+    env = {
+        e["name"]: e.get("value")
+        for e in doc["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    d_model, n_heads = int(env["D_MODEL"]), int(env["N_HEADS"])
+    prefill_len, max_seq = int(env["PREFILL_LEN"]), int(env["MAX_SEQ"])
+    assert d_model % n_heads == 0
+    head_dim = d_model // n_heads
+    probe = jnp.zeros((1, prefill_len, n_heads, head_dim), jnp.bfloat16)
+    assert flash_attention_supported(probe), (
+        f"serve env head_dim={head_dim} prefill_len={prefill_len} falls off "
+        f"the fused-kernel envelope; prefill would silently use the fallback"
+    )
+    # prompt + the TPU default decode burst must stay inside the cache
+    # (loadgen/decode.py raises at runtime; catch it at review time here)
+    assert prefill_len + 128 < max_seq
