@@ -67,6 +67,12 @@ class DecodeStats:
     prefill_tokens_per_sec: float = 0.0
 
 
+#: decode-chain length per dispatch on a real TPU (dispatch amortization);
+#: named so contract tests can check manifest env against the same number
+#: the runtime guard uses (tests/test_manifests.py serve-envelope test).
+TPU_TOKENS_PER_BURST = 128
+
+
 class RequestQueue:
     """Offered-load generator → queue → worker, in one process.
 
@@ -131,7 +137,9 @@ class DecodeLoadGen:
         )
         self.batch = batch
         if tokens_per_burst is None:
-            tokens_per_burst = 128 if jax.default_backend() == "tpu" else 4
+            tokens_per_burst = (
+                TPU_TOKENS_PER_BURST if jax.default_backend() == "tpu" else 4
+            )
         self.tokens_per_burst = tokens_per_burst
         self._params = init_params(jax.random.PRNGKey(0), self.cfg)
         self._cache = init_kv_cache(self.cfg, batch)

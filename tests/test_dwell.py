@@ -1,0 +1,57 @@
+"""The shared chained-dwell timer (utils/dwell.py) — the single methodology
+behind every committed kernel rate (bench kernel/attention blocks, the
+autotune sweep).  Its accounting must be exact: rate = flops_per_iter x
+iters / wall, measured over ONE uninterrupted on-device chain that excludes
+compilation and warm-up.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_hpa_tpu.utils.dwell import chained_dwell_tflops
+
+
+def test_dwell_measures_a_real_chain():
+    x = jnp.ones((64, 64), jnp.float32)
+    rate = chained_dwell_tflops(lambda y: y @ x, x, iters=8, flops_per_iter=2 * 64**3)
+    assert rate > 0.0
+
+
+def test_dwell_scales_with_declared_flops():
+    """The rate is linear in flops_per_iter by construction — double the
+    declared per-iteration work over the same chain shape, get ~2x the rate.
+    Chains are sized to tens of milliseconds so scheduler jitter between the
+    two independently-timed runs stays small relative to the dwell."""
+    x = jnp.ones((256, 256), jnp.float32)
+    body = lambda y: y @ x * (1.0 / 16.0)
+    iters = 64
+    lo = chained_dwell_tflops(body, x, iters=iters, flops_per_iter=1e6)
+    hi = chained_dwell_tflops(body, x, iters=iters, flops_per_iter=2e6)
+    assert 1.3 < hi / lo < 3.0
+
+
+def test_dwell_excludes_compile_and_warmup_from_the_timed_chain():
+    """The warm call must absorb one-time costs BEFORE the timer starts: a
+    body whose very first runtime application sleeps 0.6 s (via callback)
+    must not depress the measured rate — remove the warm call in
+    chained_dwell_tflops and this fails (the sleep lands inside the timed
+    chain and the rate collapses ~100x)."""
+    state = {"first": True}
+
+    def slow_once(y):
+        if state["first"]:
+            state["first"] = False
+            time.sleep(0.6)
+        return y
+
+    def body(y):
+        return jax.pure_callback(slow_once, jax.ShapeDtypeStruct(y.shape, y.dtype), y)
+
+    x = jnp.ones((8, 8), jnp.float32)
+    rate = chained_dwell_tflops(body, x, iters=4, flops_per_iter=1e9)
+    # 4 fast callback iterations take a few ms; if the 0.6 s first-call
+    # penalty leaked into the timed chain the rate would be <= 4e9/0.6/1e12
+    assert rate > 4 * 1e9 / 0.3 / 1e12
+    assert state["first"] is False  # the slow path actually ran (in warm-up)

@@ -507,4 +507,6 @@ def test_shipped_serve_env_sits_inside_flash_envelope():
     )
     # prompt + the TPU default decode burst must stay inside the cache
     # (loadgen/decode.py raises at runtime; catch it at review time here)
-    assert prefill_len + 128 < max_seq
+    from k8s_gpu_hpa_tpu.loadgen.decode import TPU_TOKENS_PER_BURST
+
+    assert prefill_len + TPU_TOKENS_PER_BURST < max_seq
