@@ -90,7 +90,6 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 from k8s_gpu_hpa_tpu.control.adapter import (
     AdapterRule,
     CustomMetricsAdapter,
-    ExternalRule,
     ObjectReference,
 )
 from k8s_gpu_hpa_tpu.control.hpa import (
@@ -1093,56 +1092,30 @@ def run_rung_external_queue() -> dict:
     """The External rung in virtual time: the shipped queue-depth HPA
     (deploy/tpu-test-external-hpa.yaml) against a demand spike on
     external.metrics.k8s.io semantics.  Control-plane latency only (no pod
-    lifecycle): spike -> steady desired replicas."""
+    lifecycle): spike -> steady desired replicas.  Wiring shared with the
+    scenario simulator and the manifest contract test
+    (control/external_sim.py)."""
+    from k8s_gpu_hpa_tpu.control.external_sim import external_sim_from_manifest
+
     hpa_doc = yaml.safe_load((DEPLOY / "tpu-test-external-hpa.yaml").read_text())
-    series = hpa_doc["spec"]["metrics"][0]["external"]["metric"]["name"]
-    labels = tuple(
-        sorted(
-            {
-                "namespace": "default",
-                **hpa_doc["spec"]["metrics"][0]["external"]["metric"]["selector"][
-                    "matchLabels"
-                ],
-            }.items()
-        )
-    )
-    clock = VirtualClock()
-    db = TimeSeriesDB(clock)
-    adapter = CustomMetricsAdapter(db, [], external_rules=[ExternalRule(series)])
-
-    class Target:
-        replicas = 1
-
-        def scale_to(self, n):
-            self.replicas = n
-
-    target = Target()
-    hpa = HPAController(
-        target=target,
-        metrics=metrics_from_manifest(hpa_doc),
-        adapter=adapter,
-        clock=clock,
-        min_replicas=hpa_doc["spec"]["minReplicas"],
-        max_replicas=hpa_doc["spec"]["maxReplicas"],
-        behavior=behavior_from_manifest(hpa_doc),
-    )
+    sim = external_sim_from_manifest(hpa_doc)
     spike_at = 10.0
     need = 3  # 240 queued / 100-per-replica AverageValue -> 3
     t_done = None
     next_sync = 15.0
-    while clock.now() < 300.0:
-        db.append(series, labels, 240.0 if clock.now() >= spike_at else 40.0, clock.now())
-        if clock.now() >= next_sync:
-            hpa.sync_once()
+    while sim.clock.now() < 300.0:
+        sim.publish(240.0 if sim.clock.now() >= spike_at else 40.0)
+        if sim.clock.now() >= next_sync:
+            sim.hpa.sync_once()
             next_sync += 15.0
-        if clock.now() >= spike_at and target.replicas == need:
-            t_done = clock.now()
+        if sim.clock.now() >= spike_at and sim.target.replicas == need:
+            t_done = sim.clock.now()
             break
-        clock.advance(1.0)
+        sim.clock.advance(1.0)
     assert t_done is not None, "external rung never reached steady desired"
     return {
         "mode": "virtual",
-        "metric": f"External {series} AverageValue",
+        "metric": f"External {sim.metric.metric_name} AverageValue",
         "spike_to_desired_s": round(t_done - spike_at, 1),
         "replicas_reached": need,
     }

@@ -1,0 +1,81 @@
+"""Shared External-metric simulation harness: one place that wires a shipped
+External HPA manifest (the queue rung, deploy/tpu-test-external-hpa.yaml)
+into the executable control-plane semantics — TSDB series under the
+manifest's own label selector, external.metrics.k8s.io adapter, and the v2
+controller.  Used by the scenario simulator (simulate.py), the bench's
+External rung (bench.py), and the manifest contract test, so the selector-
+label derivation and controller wiring cannot drift between them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from k8s_gpu_hpa_tpu.control.adapter import CustomMetricsAdapter, ExternalRule
+from k8s_gpu_hpa_tpu.control.hpa import (
+    ExternalMetricSpec,
+    HPAController,
+    behavior_from_manifest,
+    metrics_from_manifest,
+)
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+class ScaleTarget:
+    """Minimal scale-subresource stand-in (instance state, one per sim)."""
+
+    def __init__(self, replicas: int = 1):
+        self.replicas = replicas
+
+    def scale_to(self, n: int) -> None:
+        self.replicas = n
+
+
+@dataclass
+class ExternalSim:
+    clock: VirtualClock
+    db: TimeSeriesDB
+    adapter: CustomMetricsAdapter
+    hpa: HPAController
+    target: ScaleTarget
+    metric: ExternalMetricSpec
+    labels: tuple
+
+    def publish(self, value: float) -> None:
+        """One sample of the demand series under the manifest's selector
+        labels (plus the namespace tenancy label the adapter scopes by)."""
+        self.db.append(self.metric.metric_name, self.labels, value, self.clock.now())
+
+
+def external_sim_from_manifest(
+    hpa_doc: dict, clock: VirtualClock | None = None, namespace: str = "default"
+) -> ExternalSim:
+    """Build the closed External-metric control plane from a shipped HPA
+    manifest.  Raises ValueError unless the manifest carries exactly one
+    External metric (the mirror of simulate.run_scenario's Object check)."""
+    metrics = metrics_from_manifest(hpa_doc)
+    if len(metrics) != 1 or not isinstance(metrics[0], ExternalMetricSpec):
+        raise ValueError(
+            "external sim supports single External-metric HPAs (the queue "
+            "rung); got " + ", ".join(type(m).__name__ for m in metrics)
+        )
+    metric = metrics[0]
+    labels = tuple(sorted({"namespace": namespace, **metric.selector}.items()))
+    spec = hpa_doc["spec"]
+    clock = clock or VirtualClock()
+    db = TimeSeriesDB(clock)
+    adapter = CustomMetricsAdapter(
+        db, [], external_rules=[ExternalRule(metric.metric_name)]
+    )
+    target = ScaleTarget(replicas=spec.get("minReplicas", 1))
+    hpa = HPAController(
+        target=target,
+        metrics=metrics,
+        adapter=adapter,
+        clock=clock,
+        min_replicas=spec.get("minReplicas", 1),
+        max_replicas=spec["maxReplicas"],
+        behavior=behavior_from_manifest(hpa_doc),
+    )
+    return ExternalSim(clock, db, adapter, hpa, target, metric, labels)

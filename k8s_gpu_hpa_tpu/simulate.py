@@ -18,6 +18,13 @@ Scenarios (offered load in percent-of-one-chip units; replicas share it):
                  shows the hold-don't-act failure semantics.
 - ``crash``    — steady high load, one pod crashes at t=120: shows the
                  replacement paying start latency and the loop re-stabilizing.
+
+External-metric HPAs (the queue rung, deploy/tpu-test-external-hpa.yaml)
+are detected from the manifest and play the same scenario names in
+queue-depth units (requests): demand is published straight to the external
+series and the timeline shows desired replicas tracking it — control-plane
+dynamics only, no pod-load feedback (queue depth is demand, not
+utilization, so replicas do not change the offered series).
 """
 
 from __future__ import annotations
@@ -44,6 +51,14 @@ SCENARIOS = {
     "crash": lambda t: 90.0,
 }
 
+#: queue-depth demand curves (requests) for External-metric HPAs; the shipped
+#: target is 100 per replica (AverageValue), so these exercise 1 -> several
+EXTERNAL_SCENARIOS = {
+    "spike": lambda t: 340.0 if t >= 60.0 else 40.0,
+    "ramp": lambda t: 40.0 + min(400.0, max(0.0, t - 60.0) * 400.0 / 600.0),
+    "flap": lambda t: 180.0 + 30.0 * math.sin(2 * math.pi * t / 60.0),
+}
+
 
 @dataclass
 class SimReport:
@@ -53,6 +68,7 @@ class SimReport:
     )  # (t, offered, recorded, replicas, running)
     scale_events: list[tuple[float, int, int]] = field(default_factory=list)
     scale_up_latency: float | None = None  # spike: target-cross -> max replicas
+    offered_units: str = "%"  # "%" of one chip, or "req" for queue depth
 
 
 def run_scenario(
@@ -169,10 +185,56 @@ def run_scenario(
     return report
 
 
+def run_external_scenario(
+    hpa_doc: dict,
+    scenario: str = "spike",
+    duration: float = 420.0,
+    sample_every: float = 5.0,
+) -> SimReport:
+    """Simulate a shipped External-metric HPA (the queue rung) under a
+    queue-depth demand curve: demand -> external series -> adapter
+    (external.metrics.k8s.io semantics) -> HPA desired replicas.  No pod
+    lifecycle: queue depth is demand, so replicas never feed back into the
+    offered series (by design — that is what makes External proactive).
+
+    Wiring comes from control/external_sim.py — the same harness the bench's
+    External rung and the manifest contract test use."""
+    from k8s_gpu_hpa_tpu.control.external_sim import external_sim_from_manifest
+
+    if scenario not in EXTERNAL_SCENARIOS:
+        raise ValueError(
+            f"scenario {scenario!r} not available for External-metric HPAs "
+            f"(have: {', '.join(sorted(EXTERNAL_SCENARIOS))})"
+        )
+    demand_fn = EXTERNAL_SCENARIOS[scenario]
+    sim = external_sim_from_manifest(hpa_doc)
+
+    report = SimReport(
+        scenario=f"{scenario} (External queue depth)", offered_units="req"
+    )
+    prev = sim.target.replicas
+    next_sync = 15.0
+    while sim.clock.now() < duration:
+        demand = demand_fn(sim.clock.now())
+        sim.publish(demand)
+        if sim.clock.now() >= next_sync:
+            sim.hpa.sync_once()
+            next_sync += 15.0
+            if sim.target.replicas != prev:
+                report.scale_events.append((sim.clock.now(), prev, sim.target.replicas))
+                prev = sim.target.replicas
+        report.timeline.append(
+            (sim.clock.now(), demand, demand, sim.target.replicas, sim.target.replicas)
+        )
+        sim.clock.advance(sample_every)
+    return report
+
+
 def render_report(report: SimReport) -> str:
+    offered_col = "offered%" if report.offered_units == "%" else "queued"
     lines = [
         f"scenario: {report.scenario}",
-        f"{'t(s)':>6} {'offered%':>9} {'recorded':>9} {'replicas':>9} {'running':>8}",
+        f"{'t(s)':>6} {offered_col:>9} {'recorded':>9} {'replicas':>9} {'running':>8}",
     ]
     for t, offered, recorded, replicas, running in report.timeline:
         rec = f"{recorded:.1f}" if recorded is not None else "absent"
@@ -191,12 +253,26 @@ def render_report(report: SimReport) -> str:
 def main(args) -> int:
     from pathlib import Path
 
+    from k8s_gpu_hpa_tpu.control.hpa import ExternalMetricSpec
+
     hpa_doc = yaml.safe_load(Path(args.hpa).read_text())
-    report = run_scenario(
-        hpa_doc,
-        scenario=args.scenario,
-        duration=args.duration,
-        pod_start_latency=args.pod_start,
-    )
+    metrics = metrics_from_manifest(hpa_doc)
+    try:
+        if len(metrics) == 1 and isinstance(metrics[0], ExternalMetricSpec):
+            report = run_external_scenario(
+                hpa_doc, scenario=args.scenario, duration=args.duration
+            )
+        else:
+            report = run_scenario(
+                hpa_doc,
+                scenario=args.scenario,
+                duration=args.duration,
+                pod_start_latency=args.pod_start,
+            )
+    except ValueError as e:
+        # e.g. an External manifest with an Object-only scenario (outage,
+        # crash): a clean diagnosis, not a traceback
+        print(f"simulate: {e}")
+        return 2
     print(render_report(report))
     return 0

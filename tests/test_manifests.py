@@ -409,14 +409,7 @@ def test_shipped_external_hpa_scales_on_queue_depth():
     semantics (sum of matched series / replicas vs the AverageValue target).
     240 queued requests at target 100/replica -> 3 replicas; drain -> decay
     to min after the stabilization window."""
-    from k8s_gpu_hpa_tpu.control.adapter import CustomMetricsAdapter, ExternalRule
-    from k8s_gpu_hpa_tpu.control.hpa import (
-        HPAController,
-        behavior_from_manifest,
-        metrics_from_manifest,
-    )
-    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
-    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+    from k8s_gpu_hpa_tpu.control.external_sim import external_sim_from_manifest
 
     hpa_doc = load("tpu-test-external-hpa.yaml")
     adapter_doc = load("prometheus-adapter-values.yaml")
@@ -426,45 +419,11 @@ def test_shipped_external_hpa_scales_on_queue_depth():
         rule["name"]["as"] == series for rule in adapter_doc["rules"]["external"]
     )
 
-    clock = VirtualClock()
-    db = TimeSeriesDB(clock)
-    adapter = CustomMetricsAdapter(db, [], external_rules=[ExternalRule(series)])
-
-    class Target:
-        replicas = 1
-
-        def scale_to(self, n):
-            self.replicas = n
-
-    target = Target()
-    hpa = HPAController(
-        target=target,
-        metrics=metrics_from_manifest(hpa_doc),
-        adapter=adapter,
-        clock=clock,
-        min_replicas=hpa_doc["spec"]["minReplicas"],
-        max_replicas=hpa_doc["spec"]["maxReplicas"],
-        behavior=behavior_from_manifest(hpa_doc),
-    )
-
-    def publish(depth):
-        db.append(
-            series,
-            # label set the decode fleet's self-report produces (selector
-            # from the manifest, so this test can't drift from it)
-            tuple(
-                sorted(
-                    {
-                        "namespace": "default",
-                        **hpa_doc["spec"]["metrics"][0]["external"]["metric"][
-                            "selector"
-                        ]["matchLabels"],
-                    }.items()
-                )
-            ),
-            depth,
-            clock.now(),
-        )
+    # shared harness (control/external_sim.py): publish() uses the label set
+    # the decode fleet's self-report produces (selector from the manifest,
+    # so this test can't drift from it)
+    sim = external_sim_from_manifest(hpa_doc)
+    clock, hpa, target, publish = sim.clock, sim.hpa, sim.target, sim.publish
 
     for step in range(60):  # queue at 240: 240/100 -> 3 replicas
         publish(240.0)

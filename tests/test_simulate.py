@@ -73,3 +73,79 @@ def test_crash_scenario_replaces_pod_and_restabilizes():
     assert running[125.0] == settled - 1
     assert running[145.0] == settled  # replacement landed (12s start latency)
     assert report.timeline[-1][3] == settled  # replica count unchanged at end
+
+
+def test_external_queue_scenario_scales_on_demand():
+    from k8s_gpu_hpa_tpu.simulate import run_external_scenario
+
+    report = run_external_scenario(
+        load_hpa("tpu-test-external-hpa.yaml"), scenario="spike", duration=240.0
+    )
+    assert report.offered_units == "req"
+    # 340 queued / 100-per-replica AverageValue -> ceil = 4, reached via the
+    # policy-bounded steps; before the spike the replica count stays 1
+    by_t = {t: replicas for t, _, _, replicas, _ in report.timeline}
+    assert by_t[55.0] == 1
+    assert by_t[max(by_t)] == 4
+    assert report.scale_events and report.scale_events[0][1] == 1
+
+
+def test_external_flap_scenario_respects_stabilization():
+    from k8s_gpu_hpa_tpu.simulate import run_external_scenario
+
+    report = run_external_scenario(
+        load_hpa("tpu-test-external-hpa.yaml"), scenario="flap", duration=400.0
+    )
+    # demand oscillates 150..210 (need 2..3): after the initial settle the
+    # scale-down stabilization window must suppress downward flapping
+    late_replicas = [r for t, _, _, r, _ in report.timeline if t >= 100.0]
+    assert set(late_replicas) == {3}
+
+
+def test_external_cli_dispatches_from_manifest(capsys):
+    rc = main(
+        [
+            "simulate",
+            "--hpa",
+            str(DEPLOY / "tpu-test-external-hpa.yaml"),
+            "--scenario",
+            "spike",
+            "--duration",
+            "180",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "External queue depth" in out
+    assert "queued" in out
+
+
+def test_external_rejects_unknown_scenario():
+    from k8s_gpu_hpa_tpu.simulate import run_external_scenario
+
+    with pytest.raises(ValueError, match="not available"):
+        run_external_scenario(load_hpa("tpu-test-external-hpa.yaml"), scenario="crash")
+
+
+def test_external_cli_unavailable_scenario_is_a_clean_error(capsys):
+    """outage/crash pass argparse (they exist for Object manifests) but the
+    External path must refuse them with a diagnosis + exit 2, not a traceback."""
+    rc = main(
+        [
+            "simulate",
+            "--hpa",
+            str(DEPLOY / "tpu-test-external-hpa.yaml"),
+            "--scenario",
+            "outage",
+        ]
+    )
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert "not available for External-metric HPAs" in out
+
+
+def test_external_sim_rejects_object_manifests():
+    from k8s_gpu_hpa_tpu.control.external_sim import external_sim_from_manifest
+
+    with pytest.raises(ValueError, match="External-metric"):
+        external_sim_from_manifest(load_hpa("tpu-test-hpa.yaml"))
