@@ -155,3 +155,46 @@ def test_supervised_gen_leaves_healthy_worker_alone():
         assert gens[0].steps > 10
     finally:
         sup.stop()
+
+
+def test_supervised_gen_late_return_does_not_mask_second_wedge():
+    """The epoch guard on the heartbeat: when an ABANDONED worker's stalled
+    step finally returns, it must NOT refresh _last_step — otherwise a
+    concurrent wedge of the replacement generator stays undetected for
+    another watchdog period.  Scenario: gen A wedges -> swap to gen B ->
+    B wedges -> A's stall returns (heartbeat must stay stale) -> watchdog
+    must still rebuild a third generator."""
+    import time
+
+    gens = []
+
+    def factory():
+        # A wedges after 2 steps, B after 2 steps, C healthy
+        g = _FakeGen(
+            block_after=2 if len(gens) < 2 else None,
+            util_base=10.0 * (len(gens) + 1),
+        )
+        gens.append(g)
+        return g
+
+    sup = bench.SupervisedGen(factory, lambda m: None, watchdog_s=0.4)
+    sup.start()
+    try:
+        deadline = time.time() + 10.0
+        while len(gens) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(gens) >= 2, "first wedge never detected"
+        # B is now also wedged (block_after=2); release A's stalled step the
+        # moment B's worker is live — A's late return must not reset the clock
+        gens[0]._wedge.set()
+        deadline = time.time() + 10.0
+        while len(gens) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(gens) >= 3, (
+            "B's wedge went undetected — A's late return refreshed the heartbeat"
+        )
+        assert sup.utilization() == gens[2].util_base
+    finally:
+        sup.stop()
+        for g in gens:
+            g._wedge.set()
