@@ -193,6 +193,12 @@ def test_supervised_gen_late_return_does_not_mask_second_wedge():
         assert len(gens) >= 3, (
             "B's wedge went undetected — A's late return refreshed the heartbeat"
         )
+        # wait for C's worker: gens.append happens inside factory() BEFORE
+        # the watchdog assigns _gen, so reading utilization immediately
+        # could still hit B; a completed step proves the swap finished
+        deadline = time.time() + 5.0
+        while gens[2].steps == 0 and time.time() < deadline:
+            time.sleep(0.05)
         assert sup.utilization() == gens[2].util_base
     finally:
         sup.stop()

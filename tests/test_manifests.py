@@ -196,6 +196,9 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
             # exporter self-metrics (cpp/exporter)
             "tpu_metrics_exporter_up",
             "tpu_metrics_exporter_sample_age_seconds",
+            # workload self-report surfaced by the exporter (the External
+            # rung's demand signal, exporter/native.py queue gauges)
+            "tpu_test_queue_depth",
             # kube-state-metrics series from the stack install
             "kube_horizontalpodautoscaler_status_current_replicas",
             "kube_horizontalpodautoscaler_status_desired_replicas",

@@ -332,6 +332,25 @@ def build_dashboard() -> dict:
             "the count — check that minReplicas/maxReplicas are slice "
             "multiples.",
         ),
+        _ts_panel(
+            11,
+            "Serving rung: queue depth and HBM bandwidth",
+            0,
+            40,
+            [
+                _target(
+                    "sum by(queue) (tpu_test_queue_depth)",
+                    "queued {{queue}}",
+                    "A",
+                ),
+                _target("tpu_serve_hbm_bw_avg", "HBM bw util avg (%)", "B"),
+            ],
+            "The two serve-rung autoscale signals: aggregate request-queue "
+            "depth (the External HPA's demand signal, one replica per 100 "
+            "queued) and the decode fleet's recorded HBM bandwidth "
+            "utilization (the tpu-serve HPA's Object metric).  Demand "
+            "leading bandwidth saturation is the proactive-scaling story.",
+        ),
     ]
     return {
         "title": "TPU HPA pipeline",
