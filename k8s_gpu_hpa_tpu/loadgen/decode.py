@@ -61,9 +61,12 @@ class DecodeStats:
     hbm_bw_util_pct: float | None  # achieved/peak, None off-TPU
     utilization_pct: float  # busy fraction of wall time (duty cycle)
     #: prompt tokens scored per busy second (0 unless prefill_len > 0).
-    #: With prefill in the burst the bandwidth numbers above become lower
-    #: bounds: prefill seconds land in the denominator, its bytes (weights
-    #: once + cache writes) are not added to the numerator.
+    #: Prefill's HBM traffic IS counted in the bandwidth numerators (one
+    #: weight read + the cache writes for the prompt positions per burst —
+    #: ADVICE r4: with prefill seconds in the denominator and only decode
+    #: bytes in the numerator, a saturated two-phase pod would under-report
+    #: and the serve HPA would under-trigger).  Still a lower bound:
+    #: prefill's activation traffic is not modeled.
     prefill_tokens_per_sec: float = 0.0
 
 
@@ -247,6 +250,14 @@ class DecodeLoadGen:
         win_busy = sum(b for _, b in self._history)
         win_bursts = len(self._history)
         bytes_per_burst = self.tokens_per_burst * (cache_bytes + self._param_bytes)
+        if self.prefill_len:
+            # the burst's prefill phase: one weight read (the fused causal
+            # pass touches every layer once) + the KV-cache writes for the
+            # prompt positions (prefill_len of the max_seq-padded cache)
+            bytes_per_burst += (
+                self._param_bytes
+                + cache_bytes * self.prefill_len // self.cfg.max_seq
+            )
         if self._history:
             wall = max(now - self._history[0][0], win_busy, 1e-9)
         else:

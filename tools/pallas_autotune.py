@@ -69,8 +69,24 @@ def candidate_configs(size: int) -> list[tuple[str, dict]]:
                 (f"kgrid_{bm}x{bn}x{bk}", {"block_m": bm, "block_n": bn, "block_k": bk})
             )
     if not out:
-        # small sizes (CPU interpreter smoke runs): one config per kernel family
-        b = max(128, size // 2) if size % max(128, size // 2) == 0 else size
+        # small sizes (CPU interpreter smoke runs): one config per kernel
+        # family.  Prefer size//2 (a 2x2 grid exercises the grid machinery);
+        # clamp to a multiple-of-64 divisor so the block is tile-aligned (a
+        # non-aligned fallback like 100x100 would record FAILED for every
+        # candidate and return best=None — ADVICE r4).
+        half = size // 2
+        if half >= 64 and half % 64 == 0 and size % half == 0:
+            b = half
+        else:
+            b = next(
+                (c for c in range(1024, 0, -64) if c <= size and size % c == 0),
+                None,
+            )
+        if b is None:
+            raise SystemExit(
+                f"size {size} has no multiple-of-64 divisor <= 1024: no "
+                f"tile-aligned Pallas block exists; pick a multiple of 64"
+            )
         out = [
             (f"fullk_{b}x{b}", {"block_m": b, "block_n": b}),
             (f"kgrid_{b}x{b}x{b}", {"block_m": b, "block_n": b, "block_k": b}),
