@@ -725,6 +725,44 @@ def measure_attention_rates(log) -> dict | None:
     return out
 
 
+def measure_llm_train_rates(log, seconds: float = 8.0) -> dict | None:
+    """The flash-VJP payoff on the rung that pays for it (VERDICT r4 #5):
+    single-chip llm training step rate with the training attention riding
+    the fused Pallas kernel (forward + custom-VJP backward,
+    models/transformer.py::_train_attn_fn) vs forced onto the XLA ring
+    blocking — same model, same shapes, same data.  TPU-only: interpreter-
+    mode Pallas timings would be meaningless."""
+    import jax
+
+    from k8s_gpu_hpa_tpu.loadgen.llm import LlmLoadGen
+    from k8s_gpu_hpa_tpu.parallel.mesh import make_mesh
+
+    if jax.default_backend() != "tpu":
+        log("llm rates: needs the real chip; skipped")
+        return None
+    mesh = make_mesh(n_devices=1)
+    out: dict = {}
+    for impl, label in (("auto", "flash"), ("ring", "ring_xla")):
+        gen = LlmLoadGen(mesh=mesh, attn_impl=impl)
+        log(f"  compiling llm train step ({label})...")
+        gen.warmup()
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            gen.step()
+        stats = gen.stats()
+        out[label] = {
+            "steps": stats.steps,
+            "tokens_per_sec": round(stats.tokens_per_sec, 1),
+        }
+        log(f"  {label}: {out[label]['tokens_per_sec']} tokens/s")
+        del gen
+    ring_rate = out["ring_xla"]["tokens_per_sec"]
+    if ring_rate:
+        out["flash_vs_ring"] = round(out["flash"]["tokens_per_sec"] / ring_rate, 3)
+    out["shape"] = "b1 s2048 d512 h4 L4 bf16, single chip"
+    return out
+
+
 def measure_decode_rates(log, seconds: float = 8.0) -> dict:
     """The serve rung's own numbers: KV-cache decode on the chip — tokens/s
     and achieved HBM bandwidth (bytes-streamed-per-token is exact by
@@ -1869,6 +1907,7 @@ def main() -> None:
         for label, need_s, timeout_s, fn, into in (
             ("kernel", 360.0, 300.0, lambda: measure_kernel_rates(gen, log), None),
             ("attention rates", 300.0, 240.0, lambda: measure_attention_rates(log), "flash_attn"),
+            ("llm train rates", 360.0, 300.0, lambda: measure_llm_train_rates(log), "llm_train"),
             ("decode rates", 300.0, 240.0, lambda: measure_decode_rates(log), "decode"),
         ):
             if remaining_budget() < need_s:

@@ -33,6 +33,7 @@ Two self-reported signals feed the pipeline where device counters can't:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 
@@ -196,8 +197,12 @@ class DecodeLoadGen:
         self._burst = jax.jit(burst)
         self._steps = 0
         self._busy = 0.0
-        #: (t, busy_seconds) recent bursts, pruned to the window
+        #: (t, busy_seconds) recent bursts, pruned to the window.  Guarded:
+        #: the serving pod is single-threaded, but the bench's serve rung
+        #: steps from a worker thread while the scrape loop reads stats() —
+        #: _prune's check-then-pop would race without the lock.
         self._history: list[tuple[float, float]] = []
+        self._hist_lock = threading.Lock()
         self._param_bytes = sum(
             arr.size * arr.dtype.itemsize for arr in jax.tree.leaves(self._params)
         )
@@ -229,8 +234,9 @@ class DecodeLoadGen:
         dt = now - t0
         self._busy += dt
         self._steps += 1
-        self._history.append((now, dt))
-        self._prune(now)
+        with self._hist_lock:
+            self._history.append((now, dt))
+            self._prune(now)
         return dt
 
     def stats(self) -> DecodeStats:
@@ -239,7 +245,11 @@ class DecodeLoadGen:
             arr.size * arr.dtype.itemsize for arr in self._cache.values()
         )
         now = time.perf_counter()
-        self._prune(now)
+        with self._hist_lock:
+            self._prune(now)
+            win_busy = sum(b for _, b in self._history)
+            win_bursts = len(self._history)
+            first_t = self._history[0][0] if self._history else None
         # Windowed rates: bytes streamed per token-step is the full static KV
         # cache (attention reads every padded position under jit's static
         # shapes) + weights — exact by construction.  Rates divide by WALL
@@ -247,8 +257,6 @@ class DecodeLoadGen:
         # ``window`` seconds instead of freezing at its historical average
         # (the load-insensitivity trap: busy-time rates are ~constant for a
         # memory-bound kernel regardless of offered demand).
-        win_busy = sum(b for _, b in self._history)
-        win_bursts = len(self._history)
         bytes_per_burst = self.tokens_per_burst * (cache_bytes + self._param_bytes)
         if self.prefill_len:
             # the burst's prefill phase: one weight read (the fused causal
@@ -258,8 +266,8 @@ class DecodeLoadGen:
                 self._param_bytes
                 + cache_bytes * self.prefill_len // self.cfg.max_seq
             )
-        if self._history:
-            wall = max(now - self._history[0][0], win_busy, 1e-9)
+        if first_t is not None:
+            wall = max(now - first_t, win_busy, 1e-9)
         else:
             wall = 1.0  # empty window: all rates are exactly 0 below
         sustained_gbps = win_bursts * bytes_per_burst / wall / 1e9

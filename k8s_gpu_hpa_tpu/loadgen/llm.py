@@ -43,10 +43,17 @@ class LlmLoadGen:
         seq_per_device: int = 2048,
         batch: int = 1,
         d_model: int = 512,
-        n_heads: int = 8,
+        # head_dim 128 (512/4): on a single-chip mesh the training attention
+        # rides the fused flash kernel's custom VJP (forward AND backward in
+        # Pallas, models/transformer.py::_train_attn_fn); 8 heads (dim 64)
+        # would silently fall off the envelope onto the XLA blocking.
+        # Attention FLOPs are head-count-independent at fixed d_model, so
+        # the load profile is unchanged.
+        n_heads: int = 4,
         n_layers: int = 4,
         dtype=jnp.bfloat16,
         lr: float = 1e-3,
+        attn_impl: str = "auto",
     ):
         self.mesh = mesh or make_mesh()
         n = self.mesh.shape[DATA_AXIS]
@@ -60,7 +67,7 @@ class LlmLoadGen:
         )
         self.batch = batch
         self._params = init_params(jax.random.PRNGKey(0), self.cfg)
-        self._step = make_train_step(self.mesh, self.cfg, lr=lr)
+        self._step = make_train_step(self.mesh, self.cfg, lr=lr, attn_impl=attn_impl)
         self._tokens = jax.random.randint(
             jax.random.PRNGKey(1),
             (batch, self.cfg.max_seq),

@@ -152,7 +152,11 @@ def main() -> None:
             seq_per_device=int(os.environ.get("SEQ_PER_DEVICE", "2048")),
             batch=int(os.environ.get("BATCH_SIZE", "1")),
             d_model=int(os.environ.get("D_MODEL", "512")),
+            # head_dim = D_MODEL/N_HEADS; 128-aligned rides the flash
+            # custom VJP on single-chip meshes (models/transformer.py)
+            n_heads=int(os.environ.get("N_HEADS", "4")),
             n_layers=int(os.environ.get("N_LAYERS", "4")),
+            attn_impl=os.environ.get("LLM_ATTN", "auto"),
         )
 
         def report(s):

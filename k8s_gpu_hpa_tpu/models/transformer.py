@@ -106,12 +106,43 @@ def _block_forward(x, blk, cfg: TransformerConfig, attn_fn):
     return x, k, v
 
 
+def _train_attn_fn(cfg: TransformerConfig, axis: str, n: int, lq: int, attn_impl: str):
+    """The training attention op for this mesh + shape.
+
+    ``auto``: on a single-device ring (n == 1 — the single-chip llm rung and
+    any pure-DP mesh) the local shard IS the whole sequence, so the fused
+    Pallas flash kernel serves the training forward AND backward (custom
+    VJP, ops/flash_attention.py — VERDICT r4 #5) whenever the shape sits in
+    its envelope; everything else (n > 1, off-envelope shapes) rides the
+    ring's autodiff-native XLA blocking.  ``ring`` forces the XLA blocking —
+    the with/without measurement knob (bench.py kernel.llm_train)."""
+    from k8s_gpu_hpa_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_shape_supported,
+    )
+
+    if attn_impl not in ("auto", "ring"):
+        # the knob arrives via the LLM_ATTN pod env var: an unknown value
+        # (e.g. "flash") must fail loudly, not silently run the ring path
+        raise ValueError(
+            f"attn_impl must be 'auto' or 'ring', got {attn_impl!r}"
+        )
+    if (
+        attn_impl == "auto"
+        and n == 1
+        and flash_shape_supported(lq, cfg.head_dim, cfg.dtype)
+    ):
+        return lambda q, k, v: flash_attention(q, k, v, causal=True)
+    return lambda q, k, v: ring_attention_local(q, k, v, axis, n, causal=True)
+
+
 def forward_local(
     params: dict,
     tokens: jax.Array,  # [batch, local_seq] int32, this device's shard
     cfg: TransformerConfig,
     axis: str,
     n: int,
+    attn_impl: str = "auto",
 ) -> jax.Array:
     """Per-device forward (call inside shard_map over ``axis``): logits for
     the local sequence shard.  Position embeddings index by GLOBAL position
@@ -120,18 +151,14 @@ def forward_local(
     my = lax.axis_index(axis)
     pos = my * lq + jnp.arange(lq)
     x = params["embed"][tokens] + params["pos"][pos][None, :, :].astype(cfg.dtype)
+    attn_fn = _train_attn_fn(cfg, axis, n, lq, attn_impl)
 
     # layer remat (jax.checkpoint): trade FLOPs for HBM — the backward pass
     # recomputes each block's activations instead of keeping n_layers x
     # [b, lq, d_ff] residuals live, which is what bounds context length
     @jax.checkpoint
     def block(x, blk):
-        x, _, _ = _block_forward(
-            x,
-            blk,
-            cfg,
-            lambda q, k, v: ring_attention_local(q, k, v, axis, n, causal=True),
-        )
+        x, _, _ = _block_forward(x, blk, cfg, attn_fn)
         return x
 
     for blk in params["blocks"]:
@@ -142,13 +169,16 @@ def forward_local(
     )  # tied LM head, f32 logits
 
 
-def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
+def make_train_step(
+    mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3, attn_impl: str = "auto"
+):
     """(params, tokens[batch, total_seq]) -> (params, loss): one SGD step.
 
     Next-token loss over the sequence ring: each device's shard predicts its
     own next tokens (the last position of shard i predicts the first token of
     shard i+1, fetched by a single ppermute).  Grads psum over the ring axis,
-    so weights stay replicated bit-identically.
+    so weights stay replicated bit-identically.  ``attn_impl`` selects the
+    training attention op (see ``_train_attn_fn``).
     """
     n = mesh.shape[DATA_AXIS]
     seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
@@ -163,7 +193,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
     )
     def step(params, tokens):
         def local_loss(p):
-            logits = forward_local(p, tokens, cfg, DATA_AXIS, n)
+            logits = forward_local(p, tokens, cfg, DATA_AXIS, n, attn_impl)
             # target for the last local position = first token of the next
             # shard (one ring hop); the global last position wraps to shard 0
             # and is masked out of the loss

@@ -56,8 +56,14 @@ NEG_INF = -1e30  # matches ring_attention.py: large-negative beats -inf in exp m
 _STRIPE_BYTES_MAX = 12 * 1024 * 1024
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool):
-    """One (batch-head, Q block) grid step over the full resident KV stripe."""
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int, causal: bool
+):
+    """One (batch-head, Q block) grid step over the full resident KV stripe.
+    With ``lse_ref`` present (training forward), also writes the per-row
+    logsumexp ``m + log(l)`` — the single residual the backward kernels need
+    to reconstruct the probabilities without rematerializing the softmax
+    normalizer."""
     bq, d = q_ref.shape[1], q_ref.shape[2]
     seq = k_ref.shape[1]
     n_chunks = seq // block_k
@@ -99,34 +105,231 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool):
     m, l, acc = lax.fori_loop(0, hi, chunk, (m0, l0, acc0))
     # causal rows always attend to their own position, so l > 0; the floor
     # only guards a hypothetical all-masked row (same note as ring_attention)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    if lse_ref:
+        lse_ref[0][0] = m + jnp.log(l_safe)  # [bq, 1] f32
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
-    """Pallas call on [b*h, seq, d] operands."""
-    bh, seq, d = q.shape
-    interpret = jax.default_backend() != "tpu"
+def _compiler_params():
     try:
-        params = pltpu.CompilerParams(
+        return pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel"),
             vmem_limit_bytes=100 * 1024 * 1024,
         )
     except Exception:  # pragma: no cover
-        params = None
-    return pl.pallas_call(
+        return None
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "with_lse")
+)
+def _flash_bhsd(
+    q, k, v, causal: bool, block_q: int, block_k: int, with_lse: bool = False
+):
+    """Pallas call on [b*h, seq, d] operands.  ``with_lse`` (training
+    forward) adds the [b*h, seq, 1] f32 logsumexp output."""
+    bh, seq, d = q.shape
+    interpret = jax.default_backend() != "tpu"
+    out_shape = [jax.ShapeDtypeStruct((bh, seq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0))]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)))
+    out = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, causal=causal),
-        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        out_shape=out_shape,
         grid=(bh, seq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
             pl.BlockSpec((1, seq, d), lambda bh, iq: (bh, 0, 0)),
             pl.BlockSpec((1, seq, d), lambda bh, iq: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
-        compiler_params=params,
+        out_specs=out_specs,
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(q, k, v)
+    return (out[0], out[1]) if with_lse else out[0]
+
+
+# ---- backward kernels (training path: VERDICT r4 #5) -----------------------
+#
+# Standard recompute-based flash backward, laid out like the forward: the
+# whole counterpart stripe rides into VMEM per grid step, probabilities are
+# reconstructed from the saved logsumexp (never stored), and the causal
+# triangle is SKIPPED via dynamic loop bounds on both kernels.  Two kernels
+# because the two gradients parallelize over different axes race-free:
+# dQ over Q blocks (each owns its output rows), dK/dV over KV chunks.
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k: int, causal: bool,
+):
+    """dQ for one (batch-head, Q block): loop over the resident KV stripe.
+    dS = P * (dO V^T - delta) * scale;  dQ = sum_j dS_j K_j."""
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    seq = k_ref.shape[1]
+    n_chunks = seq // block_k
+    iq = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]  # [bq, d]
+    lse = lse_ref[0]  # [bq, 1] f32
+    delta = delta_ref[0]  # [bq, 1] f32
+    scale = 1.0 / (d ** 0.5)
+
+    def chunk(j, dq):
+        kc = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vc = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(
+            q, kc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # masked entries: exp(NEG_INF - lse) == 0
+        dp = lax.dot_general(
+            do, vc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
+        return dq + jnp.dot(ds, kc, preferred_element_type=jnp.float32)
+
+    hi = (
+        jnp.minimum(n_chunks, ((iq + 1) * bq + block_k - 1) // block_k)
+        if causal
+        else n_chunks
+    )
+    dq = lax.fori_loop(0, hi, chunk, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, causal: bool,
+):
+    """dK and dV for one (batch-head, KV chunk): loop over the resident
+    Q/dO stripes.  dV = sum_i P_i^T dO_i;  dK = sum_i dS_i^T Q_i."""
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    seq = q_ref.shape[1]
+    n_chunks = seq // block_q
+    jk = pl.program_id(1)
+    kc = k_ref[0]
+    vc = v_ref[0]
+    scale = 1.0 / (d ** 0.5)
+
+    def chunk(i, carry):
+        dk, dv = carry
+        qc = q_ref[0, pl.ds(i * block_q, block_q), :]
+        doc = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]  # [bq, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = lax.dot_general(
+            qc, kc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = jk * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk] f32
+        dv = dv + lax.dot_general(
+            p.astype(q_ref.dtype), doc,
+            (((0,), (0,)), ((), ())),  # p^T @ dO -> [bk, d]
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            doc, vc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
+        dk = dk + lax.dot_general(
+            ds, qc, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, d]
+        return dk, dv
+
+    # causal: KV chunk j is fully masked for Q chunks whose LAST row is
+    # still above the diagonal — start at the first chunk with any
+    # unmasked row (i*bq + bq - 1 >= jk*bk)
+    lo = (jk * bk) // block_q if causal else 0
+    dk, dv = lax.fori_loop(
+        lo,
+        n_chunks,
+        chunk,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bhsd_bwd(q, k, v, o, lse, do, causal, block_q, block_k):
+    """The two backward pallas calls on [b*h, seq, d] operands."""
+    bh, seq, d = q.shape
+    interpret = jax.default_backend() != "tpu"
+    # delta = rowsum(dO * O): one cheap fused XLA pass, saved work for both
+    # kernels (the FlashAttention-2 trick)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [bh, seq, 1]
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        grid=(bh, seq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),  # q
+            pl.BlockSpec((1, seq, d), lambda bh, iq: (bh, 0, 0)),  # k stripe
+            pl.BlockSpec((1, seq, d), lambda bh, iq: (bh, 0, 0)),  # v stripe
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),  # do
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)),  # lse
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+        ],
+        grid=(bh, seq // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq, d), lambda bh, jk: (bh, 0, 0)),  # q stripe
+            pl.BlockSpec((1, block_k, d), lambda bh, jk: (bh, jk, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda bh, jk: (bh, jk, 0)),  # v
+            pl.BlockSpec((1, seq, d), lambda bh, jk: (bh, 0, 0)),  # do stripe
+            pl.BlockSpec((1, seq, 1), lambda bh, jk: (bh, 0, 0)),  # lse stripe
+            pl.BlockSpec((1, seq, 1), lambda bh, jk: (bh, 0, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, jk: (bh, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, jk: (bh, jk, 0)),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd_diff(q, k, v, causal, block_q, block_k):
+    """Differentiable fused attention on [b*h, seq, d]: Pallas forward AND
+    Pallas backward (dQ/dKV kernels above), so training steps never pay the
+    [seq, seq] HBM materialization in either direction."""
+    return _flash_bhsd(q, k, v, causal, block_q, block_k)
+
+
+def _flash_diff_fwd(q, k, v, causal, block_q, block_k):
+    o, lse = _flash_bhsd(q, k, v, causal, block_q, block_k, with_lse=True)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_diff_bwd(causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    return _flash_bhsd_bwd(q, k, v, o, lse, do, causal, block_q, block_k)
+
+
+_flash_bhsd_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 def _fit_block(seq: int, want: int) -> int | None:
@@ -141,22 +344,32 @@ def _fit_block(seq: int, want: int) -> int | None:
     return None
 
 
-def flash_attention_supported(
-    q: jax.Array, block_q: int = 512, block_k: int = 512
+def flash_shape_supported(
+    seq: int, head_dim: int, dtype, block_q: int = 512, block_k: int = 512
 ) -> bool:
-    """Shape envelope for the fused kernel: MXU-aligned head_dim, a sequence
-    some block size <= the requested one divides, KV stripe within the VMEM
-    budget."""
-    if not HAVE_PALLAS or q.ndim != 4:
+    """Static shape envelope for the fused kernel: MXU-aligned head_dim, a
+    sequence some block size <= the requested one divides, KV stripe within
+    the VMEM budget.  Callers that know shapes before forming arrays (e.g.
+    models/transformer.py choosing the training attention op) gate here."""
+    if not HAVE_PALLAS:
         return False
-    _, seq, _, d = q.shape
-    stripe = seq * d * jnp.dtype(q.dtype).itemsize
+    stripe = seq * head_dim * jnp.dtype(dtype).itemsize
     return (
-        d % 128 == 0
+        head_dim % 128 == 0
         and _fit_block(seq, block_q) is not None
         and _fit_block(seq, block_k) is not None
         and stripe <= _STRIPE_BYTES_MAX
     )
+
+
+def flash_attention_supported(
+    q: jax.Array, block_q: int = 512, block_k: int = 512
+) -> bool:
+    """Array-operand form of the envelope check."""
+    if q.ndim != 4:
+        return False
+    _, seq, _, d = q.shape
+    return flash_shape_supported(seq, d, q.dtype, block_q, block_k)
 
 
 def flash_attention(
@@ -168,13 +381,14 @@ def flash_attention(
     block_k: int = 512,
 ) -> jax.Array:
     """Fused exact attention, [batch, seq, heads, head_dim] in and out (the
-    repo's layout, same as ring_attention/reference_attention).  Forward-only
-    (no custom VJP): this is the inference/prefill hot op — training paths
-    use the autodiff-friendly XLA blocking in ops/ring_attention.py.
+    repo's layout, same as ring_attention/reference_attention).  Fully
+    differentiable ON the kernel path (custom VJP: Pallas forward saving
+    only O + logsumexp, Pallas dQ/dKV backward kernels — VERDICT r4 #5), so
+    both the serving prefill AND the training step ride the fused kernel.
 
     Falls back to the naive XLA path off the supported envelope (unaligned
     shapes, cross-attention with lk != lq, no Pallas) so callers never
-    branch.
+    branch; the fallback is autodiff-native.
     """
     if q.shape != k.shape or q.shape != v.shape or not flash_attention_supported(
         q, block_q, block_k
@@ -189,7 +403,7 @@ def flash_attention(
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    out = _flash_bhsd(
+    out = _flash_bhsd_diff(
         to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k
     )
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -83,3 +83,105 @@ def test_block_fitting_stays_exact():
     got = flash_attention(q, k, v, causal=True)  # default 512 -> fitted 64
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
+
+
+# ---- custom VJP: the training path (VERDICT r4 #5) -------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    """The Pallas backward kernels (dQ, dK/dV) against autodiff through the
+    naive reference — every gradient, both masking modes."""
+    q, k, v = qkv(seq=256, head_dim=128)
+    do = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+
+    def r(q, k, v):
+        return reference_attention(q, k, v, causal=causal)
+
+    _, vjp_f = jax.vjp(f, q, k, v)
+    _, vjp_r = jax.vjp(r, q, k, v)
+    for name, got, want in zip(("dq", "dk", "dv"), vjp_f(do), vjp_r(do)):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(want, np.float32),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=f"{name} (causal={causal})",
+        )
+
+
+def test_gradients_with_uneven_blocks_and_skipping():
+    """block_q != block_k exercises both kernels' causal skip bounds (the
+    dQ upper bound and the dKV lower bound) at chunk boundaries that do not
+    coincide."""
+    q, k, v = qkv(seq=384, head_dim=128)
+    do = jax.random.normal(jax.random.PRNGKey(8), q.shape, q.dtype)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
+
+    def r(q, k, v):
+        return reference_attention(q, k, v, causal=True)
+
+    _, vjp_f = jax.vjp(f, q, k, v)
+    _, vjp_r = jax.vjp(r, q, k, v)
+    for name, got, want in zip(("dq", "dk", "dv"), vjp_f(do), vjp_r(do)):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(want, np.float32),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=name,
+        )
+
+
+def test_llm_training_rides_flash_on_single_device_mesh():
+    """End to end: on a 1-device mesh at an envelope shape, the llm
+    generator's training step (shard_map + remat + SGD) runs the flash
+    custom VJP and lands the same loss as the forced ring/XLA path."""
+    from k8s_gpu_hpa_tpu.loadgen.llm import LlmLoadGen
+    from k8s_gpu_hpa_tpu.models.transformer import TransformerConfig, _train_attn_fn
+    from k8s_gpu_hpa_tpu.ops.flash_attention import flash_shape_supported
+    from k8s_gpu_hpa_tpu.parallel.mesh import make_mesh
+
+    # the rung's shape (d512 h4 -> head_dim 128) sits inside the envelope
+    assert flash_shape_supported(2048, 128, jnp.bfloat16)
+    # _train_attn_fn selects flash ONLY on a single-device ring: the flash
+    # kernel has no collectives, so a multi-device ring must get the
+    # ppermute path (distinguish branches by the closure's referenced names)
+    cfg = TransformerConfig(d_model=128, n_heads=1, max_seq=128)
+    def branch_of(fn) -> str:
+        names = fn.__code__.co_names + fn.__code__.co_freevars
+        return "flash" if "flash_attention" in names else "ring"
+
+    assert branch_of(_train_attn_fn(cfg, "data", 2, 128, "auto")) == "ring"
+    assert branch_of(_train_attn_fn(cfg, "data", 1, 128, "auto")) == "flash"
+    # off-envelope (head_dim 32): single-device still rides the ring path
+    cfg32 = TransformerConfig(d_model=128, n_heads=4, max_seq=128)
+    assert branch_of(_train_attn_fn(cfg32, "data", 1, 128, "auto")) == "ring"
+    # the pod-env knob rejects unknown values instead of silently misrouting
+    import pytest
+
+    with pytest.raises(ValueError, match="attn_impl"):
+        _train_attn_fn(cfg, "data", 1, 128, "flash")
+
+    mesh = make_mesh(n_devices=1)
+    losses = {}
+    for impl in ("auto", "ring"):
+        gen = LlmLoadGen(
+            mesh=mesh,
+            seq_per_device=128,
+            batch=1,
+            d_model=128,
+            n_heads=1,
+            n_layers=2,
+            attn_impl=impl,
+        )
+        gen.warmup()
+        gen.step()
+        losses[impl] = gen.stats().last_loss
+    assert np.isfinite(losses["auto"])
+    assert abs(losses["auto"] - losses["ring"]) < 0.05
