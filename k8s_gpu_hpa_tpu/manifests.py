@@ -21,6 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.rules import (
+    SERVE_BW_TARGET,
     AlertRule,
     RecordingRule,
     shipped_alert_rules,
@@ -991,7 +992,12 @@ def default_bundle() -> dict[str, list[dict]]:
                 "tpu-serve",
                 metrics=[
                     object_metric(
-                        "tpu_serve_hbm_bw_avg", "Deployment", "tpu-serve", "60"
+                        "tpu_serve_hbm_bw_avg",
+                        "Deployment",
+                        "tpu-serve",
+                        # single-sourced with the TpuServeTargetUnreachable
+                        # alert band (metrics/rules.py::SERVE_BW_TARGET)
+                        str(int(SERVE_BW_TARGET)),
                     )
                 ],
             )

@@ -398,6 +398,64 @@ def pipeline_alert_rules(
     ]
 
 
+#: THE serve-rung HPA target (percent HBM bandwidth): single-sourced here so
+#: the shipped HPA manifest (manifests.py), the unreachable-target alert
+#: below, and the bench's headroom check can never drift apart.
+SERVE_BW_TARGET = 60.0
+
+
+def _app_duty_max(app: str) -> Expr:
+    """max over ``app``'s pods of the per-chip duty cycle (the busy-fraction
+    gauge every generator self-reports) — the 'is the workload demonstrably
+    active' conjunct shared by the flat-zero and unreachable-target alerts."""
+    return Aggregate(
+        "max",
+        MulOnGroupLeft(
+            left=MaxBy(("pod",), Select(TPU_DUTY_CYCLE)),
+            right=MaxBy(
+                ("pod",), Select("kube_pod_labels", {"label_app": app})
+            ),
+            on=("pod",),
+        ),
+    )
+
+
+def serve_target_unreachable_alert(
+    target: float = SERVE_BW_TARGET, for_seconds: float = 600.0
+) -> AlertRule:
+    """The round-4 shipped defect, made detectable at runtime: the serve
+    fleet is demonstrably saturated (duty cycle pegged above 90 %) while the
+    bandwidth signal its HPA scales on sits BELOW every equilibrium the HPA
+    would hold.  The band matters: autoscaling/v2's 10 % tolerance means a
+    correctly paired fleet can legitimately converge anywhere in
+    [target x 0.9, target x 1.1] — an alert band overlapping that range
+    would page a healthy hot fleet forever.  Below target x 0.9 there is
+    active scale-DOWN pressure, so "pods pegged while the signal argues for
+    fewer replicas" can only mean the signal cannot follow the load: sizes
+    too small to push bandwidth (r4 shipped 6.3 % saturated against a 60
+    target — the silent-dead-joint mode the flat-zero alert cannot catch
+    because 6.3 != 0), a broken fallback chain, or a wildly mis-tuned
+    target.  10 minutes of ``for:``: scale transients clear in a couple of
+    sync periods; a persistent saturated-but-sub-band state is structural."""
+    band = target * 0.9  # 1 - autoscaling/v2 tolerance (HPAController)
+    return AlertRule(
+        alert="TpuServeTargetUnreachable",
+        expr=AndOn(
+            Cmp(Select("tpu_serve_hbm_bw_avg"), "<", band),
+            Cmp(_app_duty_max("tpu-serve"), ">", 90.0),
+        ),
+        for_seconds=for_seconds,
+        labels={"severity": "warning"},
+        annotations={
+            "summary": "tpu-serve pods have been saturated (duty > 90%) for "
+            "10m while tpu_serve_hbm_bw_avg sits below every HPA "
+            f"equilibrium (< {band:g}, the tolerance band floor): the "
+            "autoscale signal cannot follow the load — resize the workload, "
+            "fix the bandwidth fallback chain, or retune the target"
+        },
+    )
+
+
 def flat_zero_alert(record: str, app: str) -> AlertRule:
     """The autoscale series is present but pinned at zero while the workload
     is demonstrably active.  Catches what Absent cannot: a source feeding
@@ -434,16 +492,7 @@ def flat_zero_alert(record: str, app: str) -> AlertRule:
             on=("pod",),
         ),
     )
-    app_duty = Aggregate(
-        "max",
-        MulOnGroupLeft(
-            left=MaxBy(("pod",), Select(TPU_DUTY_CYCLE)),
-            right=MaxBy(
-                ("pod",), Select("kube_pod_labels", {"label_app": app})
-            ),
-            on=("pod",),
-        ),
-    )
+    app_duty = _app_duty_max(app)
     return AlertRule(
         alert="TpuAutoscaleSignalFlatZero",
         expr=AndOn(
@@ -546,6 +595,7 @@ def shipped_alert_rules() -> list[AlertRule]:
     and its flatline must page even while the tensorcore rung is healthy."""
     return pipeline_alert_rules() + [
         flat_zero_alert("tpu_serve_hbm_bw_avg", "tpu-serve"),
+        serve_target_unreachable_alert(),
         device_counters_dead_alert(),
         chip_hot_alert(),
         slice_held_partial_alert(),

@@ -233,3 +233,62 @@ def test_shipped_alert_group_matches_asts():
         assert entry["expr"] == rule.expr.promql()
         assert entry["for"] == f"{int(rule.for_seconds)}s"
         assert entry["labels"] == rule.labels
+
+
+def test_serve_target_unreachable_alert_catches_the_inert_pairing():
+    """The r4 shipped defect as a runtime page: serve pods pegged (duty >
+    90) while the bandwidth signal sits below the HPA's actionable band
+    (target x 1.1) for 10 minutes.  The flat-zero alert cannot see it
+    (6.3 != 0); this one exists precisely for the saturated-but-
+    unactionable state.  False-fire guards: a healthy pairing whose signal
+    crosses the band (scaling proceeds), and a fleet that is merely idle
+    (low duty) with a low signal."""
+    from k8s_gpu_hpa_tpu.metrics.rules import (
+        SERVE_BW_TARGET,
+        serve_target_unreachable_alert,
+    )
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    alert = serve_target_unreachable_alert()
+    evaluator = RuleEvaluator(db, [], alerts=[alert])
+    POD = "tpu-serve-abc"
+
+    def tick(signal, duty, steps=1):
+        for _ in range(steps):
+            db.append("tpu_serve_hbm_bw_avg", (("deployment", "tpu-serve"),), signal)
+            db.append(
+                "kube_pod_labels", (("label_app", "tpu-serve"), ("pod", POD)), 1.0
+            )
+            db.append("tpu_duty_cycle", (("chip", "0"), ("pod", POD)), duty)
+            evaluator.evaluate_once()
+            clock.advance(1.0)
+
+    # idle fleet, low signal: nothing wrong — never fires
+    tick(signal=6.3, duty=5.0, steps=700)
+    assert not alert.firing
+
+    # healthy pairing: saturated AND the signal clears the band — no fire
+    tick(signal=SERVE_BW_TARGET * 1.3, duty=98.0, steps=700)
+    assert not alert.firing
+
+    # healthy HOT fleet converged inside the HPA's tolerance equilibrium
+    # ([target*0.9, target*1.1]): pods busy, signal exactly at target —
+    # must NOT page (the band sits strictly below every equilibrium)
+    tick(signal=SERVE_BW_TARGET, duty=95.0, steps=700)
+    assert not alert.firing
+    tick(signal=SERVE_BW_TARGET * 0.9, duty=95.0, steps=700)
+    assert not alert.firing
+
+    # the r4 defect: pegged pods, signal stuck at its measured 6.3 —
+    # pending through the 600 s window, then fires
+    for t in range(700):
+        tick(signal=6.3, duty=98.0)
+        if t < 599:
+            assert not alert.firing, f"fired early at t={t}"
+    assert alert.firing
+
+    # remediation lands (resized workload pushes the signal over the band):
+    # resets immediately
+    tick(signal=SERVE_BW_TARGET * 1.2, duty=98.0)
+    assert not alert.firing

