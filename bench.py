@@ -173,6 +173,11 @@ BUDGET_S = BASE_BUDGET_S * TIME_SCALE
 #: regression is visible instead of absorbed by a shared margin.
 SCALE_DOWN_BUDGET_S = {"real_chip": 255.0, "cpu_fallback": 210.0}
 SCALE_DOWN_MAX_FLAPS = 0
+#: the serve pairing counts as reachable only STRICTLY above the HPA's
+#: tolerance band (|ratio-1| <= 0.1 never scales, control/hpa.py): at
+#: exactly 1.1x the controller still holds, so >= would mark a pairing
+#: reachable, burn the drive deadline, and let the defect exit 0
+SERVE_REACHABLE_HEADROOM = 1.1
 #: Overshoot budget (BASELINE.md, now actually enforced — VERDICT r4 #3):
 #: the behavior stanza + 1 s-fresh metrics must hold metric-lag overshoot
 #: at 0; a completed probe observing more fails the run.
@@ -1242,6 +1247,38 @@ def run_rung_serve(log) -> dict:
         f"{gen.peak_hbm_gbps:.0f} GB/s peak vs target {target:g} "
         f"(headroom {headroom:.2f}x)"
     )
+    base = {
+        "mode": _live_mode(),
+        "metric": "Object tpu_serve_hbm_bw_avg (shipped manifest pair)",
+        # `is not None`: a DEAD gauge measuring 0.0 must record 0.0, not
+        # null (null means "could not measure")
+        "saturated_signal_pct": (
+            round(saturated_pct, 1) if saturated_pct is not None else None
+        ),
+        "target_pct": target,
+        "headroom_x": round(headroom, 2),
+        "target_reachable": headroom > SERVE_REACHABLE_HEADROOM,
+        "tokens_per_sec_saturated": round(sat_stats.tokens_per_sec, 1),
+        "achieved_gbps_saturated": round(sat_stats.achieved_gbps, 1),
+        "signal": (
+            "measured decode+prefill bytes / public chip peak"
+            if on_tpu
+            else "measured bytes / synthetic calibrated peak (cpu stand-in sizes)"
+        ),
+    }
+    if not base["target_reachable"]:
+        # the r4 defect, measured instead of timed out: the shipped
+        # workload's saturated signal cannot clear the actionable band, so
+        # driving the loop would burn the 300 s deadline to say the same
+        # thing.  The caller fails the bench budget on this in real_chip
+        # mode (the pairing is shipped-inert — exactly what this rung
+        # exists to catch).
+        log("  INERT PAIRING: saturated signal below the actionable band")
+        base["inert"] = (
+            "closed loop not attempted: the shipped workload cannot reach "
+            "its own HPA target at saturation"
+        )
+        return base
 
     clock = SystemClock()
     deployment = MirrorDeployment(clock)
@@ -1334,27 +1371,15 @@ def run_rung_serve(log) -> dict:
             clock, deployment, scraper, evaluator, hpa, crossed, tick, log,
             max_replicas=max_replicas,
         )
+    except Exception as e:
+        # the reachability fields must survive a failed drive (a tunnel
+        # stall, or a boundary pairing the controller holds on): without
+        # them the caller's inert-budget check could never see the rung
+        return base | {"error": str(e)}
     finally:
         stop.set()
         worker.join(timeout=30.0)
-    result.update(
-        {
-            "mode": _live_mode(),
-            "metric": "Object tpu_serve_hbm_bw_avg (shipped manifest pair)",
-            "saturated_signal_pct": round(saturated_pct, 1) if saturated_pct else None,
-            "target_pct": target,
-            "headroom_x": round(headroom, 2),
-            "target_reachable": headroom >= 1.1,  # HPA tolerance band is 10%
-            "tokens_per_sec_saturated": round(sat_stats.tokens_per_sec, 1),
-            "achieved_gbps_saturated": round(sat_stats.achieved_gbps, 1),
-            "signal": (
-                "measured decode+prefill bytes / public chip peak"
-                if on_tpu
-                else "measured bytes / synthetic calibrated peak (cpu stand-in sizes)"
-            ),
-        }
-    )
-    return result
+    return base | result
 
 
 # ---- virtual-time rungs (configs 0, 4, and the External queue rung) --------
@@ -1955,6 +1980,19 @@ def main() -> None:
                 # than sinking the whole bench
                 log(f"  rung failed: {e}")
                 rungs[name] = {"mode": mode, "error": str(e)}
+            if (
+                name == "serve_hbm_bw"
+                and mode == "real_chip"
+                and rungs[name].get("target_reachable") is False
+            ):
+                # the serve pairing shipping inert on real hardware is a
+                # bench-failing defect, not a data point (VERDICT r4 weak #1)
+                budget_failures.append(
+                    "serve pairing inert: saturated signal "
+                    f"{rungs[name].get('saturated_signal_pct')}% cannot reach "
+                    f"target {rungs[name].get('target_pct')} "
+                    f"(need > {SERVE_REACHABLE_HEADROOM}x)"
+                )
             emit()
 
         # final extended line: the last stdout line always carries the most
