@@ -201,6 +201,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     sim.add_argument("--duration", type=float, default=420.0)
     sim.add_argument("--pod-start", type=float, default=12.0)
+    sim.add_argument(
+        "--saturated-pct",
+        type=float,
+        default=None,
+        help="the workload's MEASURED signal ceiling (tools/serve_sizing.py): "
+        "caps the simulated per-pod gauge so an inert manifest/workload "
+        "pairing (ceiling below target x 1.1) is diagnosed instead of "
+        "simulated as healthy",
+    )
 
     genm = sub.add_parser(
         "gen-manifests", help="check or write the generated shipped manifests"

@@ -72,6 +72,7 @@ class SimDeployment:
         load_mode: str = "shared",  # "shared" | "per_pod"
         hosts_per_slice: int = 1,
         barrier_idle_util: float = 2.0,
+        util_cap: float = 100.0,
     ):
         self.cluster = cluster
         self.name = name
@@ -88,6 +89,11 @@ class SimDeployment:
         # why the HPA needs replica_quantum (control/hpa.py).
         self.hosts_per_slice = hosts_per_slice
         self.barrier_idle_util = barrier_idle_util
+        #: the workload's measured signal ceiling: a real generator's gauge
+        #: saturates at what its kernels can push (r4's shipped serve pod:
+        #: 6.3 % HBM bandwidth), NOT at 100 — simulating an ideal ceiling
+        #: is how an inert pairing looks healthy in a simulator
+        self.util_cap = util_cap
         self.replicas = 0
 
     def scale_to(self, replicas: int) -> None:
@@ -108,15 +114,17 @@ class SimDeployment:
             n_slices = len(ordered) // self.hosts_per_slice
             active = ordered[: n_slices * self.hosts_per_slice]
             if pod not in active:
-                return self.barrier_idle_util
+                # a barrier-idle host can never read hotter than the
+                # workload's measured ceiling
+                return min(self.util_cap, self.barrier_idle_util)
             if self.load_mode == "per_pod":
-                return min(100.0, offered)
-            return min(100.0, offered / n_slices)
+                return min(self.util_cap, offered)
+            return min(self.util_cap, offered / n_slices)
         if self.load_mode == "per_pod":
-            return min(100.0, offered)
+            return min(self.util_cap, offered)
         if not running:
             return 0.0
-        return min(100.0, offered / len(running))
+        return min(self.util_cap, offered / len(running))
 
 
 @dataclass

@@ -69,6 +69,8 @@ class SimReport:
     scale_events: list[tuple[float, int, int]] = field(default_factory=list)
     scale_up_latency: float | None = None  # spike: target-cross -> max replicas
     offered_units: str = "%"  # "%" of one chip, or "req" for queue depth
+    #: reachability verdict when a measured signal ceiling was supplied
+    target_note: str | None = None
 
 
 def run_scenario(
@@ -77,11 +79,19 @@ def run_scenario(
     duration: float = 420.0,
     pod_start_latency: float = 12.0,
     sample_every: float = 5.0,
+    saturated_pct: float | None = None,
 ) -> SimReport:
     """Simulate one shipped Object-metric HPA manifest under a load scenario.
 
     Behavior, bounds, target, and slice quantum all come from the manifest —
     the same parsing path the tests and bench use (the manifest IS the spec).
+
+    ``saturated_pct`` caps the per-pod signal at the workload's MEASURED
+    ceiling (e.g. `tools/serve_sizing.py` output).  The default (no cap)
+    models an ideal workload whose gauge can reach 100 — which is exactly
+    how round 4's inert serve pairing (saturated 6.3 % vs target 60) would
+    have looked healthy in a simulator.  With the cap, "will my sizes ever
+    cross my target?" gets answered before anything touches a cluster.
     """
     load_fn = SCENARIOS[scenario]
     spec = hpa_doc["spec"]
@@ -110,6 +120,7 @@ def run_scenario(
         load_fn=load_fn,
         load_mode="shared",
         hosts_per_slice=quantum,
+        util_cap=saturated_pct if saturated_pct is not None else 100.0,
     )
     cluster.add_deployment(dep, replicas=spec.get("minReplicas", 1))
     clock.advance(15.0)
@@ -138,6 +149,21 @@ def run_scenario(
     report = SimReport(scenario=scenario)
     t_cross = None
     target_value = metrics[0].target_value
+    if saturated_pct is not None:
+        # HPA tolerance: values within 10% of target never trigger — the
+        # ceiling must clear target*1.1 STRICTLY or the manifest can never
+        # scale this workload (bench.py's serve rung measures the same)
+        if saturated_pct > target_value * 1.1:
+            report.target_note = (
+                f"signal ceiling {saturated_pct:g} clears the actionable "
+                f"band (> {target_value * 1.1:g}): target reachable"
+            )
+        else:
+            report.target_note = (
+                f"INERT PAIRING: signal ceiling {saturated_pct:g} cannot "
+                f"clear the actionable band (> {target_value * 1.1:g} "
+                f"needed) — this HPA will never scale this workload"
+            )
     elapsed = 0.0
     while elapsed < duration:
         if outage_window and originals == [] and elapsed >= outage_window[0]:
@@ -247,6 +273,8 @@ def render_report(report: SimReport) -> str:
             f"scale-up latency (signal crossing -> all replicas running): "
             f"{report.scale_up_latency:.0f}s"
         )
+    if report.target_note is not None:
+        lines.append(report.target_note)
     return "\n".join(lines)
 
 
@@ -259,6 +287,17 @@ def main(args) -> int:
     metrics = metrics_from_manifest(hpa_doc)
     try:
         if len(metrics) == 1 and isinstance(metrics[0], ExternalMetricSpec):
+            if getattr(args, "saturated_pct", None) is not None:
+                # queue depth is demand, not a utilization gauge: a signal
+                # ceiling has no meaning here, and silently ignoring the
+                # flag would read as "pairing healthy" — the exact failure
+                # the flag exists to prevent
+                print(
+                    "simulate: --saturated-pct applies to utilization-gauge "
+                    "HPAs; External queue-depth metrics have no signal "
+                    "ceiling (demand is unbounded)"
+                )
+                return 2
             report = run_external_scenario(
                 hpa_doc, scenario=args.scenario, duration=args.duration
             )
@@ -268,6 +307,7 @@ def main(args) -> int:
                 scenario=args.scenario,
                 duration=args.duration,
                 pod_start_latency=args.pod_start,
+                saturated_pct=getattr(args, "saturated_pct", None),
             )
     except ValueError as e:
         # e.g. an External manifest with an Object-only scenario (outage,

@@ -122,3 +122,43 @@ def test_serve_rung_closes_loop_min_to_max_on_measured_signal():
     assert result["target_reachable"] is True
     assert result["saturated_signal_pct"] > result["target_pct"]
     assert result["mode"] == "cpu_fallback"
+
+
+def test_serve_rung_inert_pairing_detected_without_drive(monkeypatch):
+    """The r4 defect path: a workload whose saturated signal cannot clear
+    the tolerance band returns the measured verdict in seconds — no 300 s
+    drive-loop burn, no RuntimeError — with the reachability fields the
+    bench's budget check keys on."""
+    import time
+
+    import bench
+
+    orig = bench.make_serve_gen
+
+    def low_signal_gen(shrink=False):
+        gen = orig(shrink=True)
+        # inflate the calibrated peak 100x: saturated signal ~0.9% vs 60
+        gen.peak_hbm_gbps = gen.peak_hbm_gbps * 100
+        return gen
+
+    monkeypatch.setattr(bench, "make_serve_gen", low_signal_gen)
+    t0 = time.monotonic()
+    result = bench.run_rung_serve(lambda m: None)
+    assert time.monotonic() - t0 < 60
+    assert result["target_reachable"] is False
+    assert "inert" in result
+    assert "scale_up_s" not in result
+    assert result["saturated_signal_pct"] is not None
+
+
+def test_serve_reachability_boundary_is_strict():
+    """At headroom exactly 1.1 the HPA tolerance band still holds (no
+    scale), so the rung must call it unreachable — `>=` in the predicate
+    shipped the escape where a boundary pairing burned the deadline and
+    exited 0.  This exercises the predicate run_rung_serve actually uses."""
+    import bench
+
+    assert bench.SERVE_REACHABLE_HEADROOM == 1.1
+    assert bench.serve_target_reachable(1.2) is True
+    assert bench.serve_target_reachable(1.1) is False  # boundary: holds
+    assert bench.serve_target_reachable(0.1) is False

@@ -149,3 +149,38 @@ def test_external_sim_rejects_object_manifests():
 
     with pytest.raises(ValueError, match="External-metric"):
         external_sim_from_manifest(load_hpa("tpu-test-hpa.yaml"))
+
+
+def test_saturated_ceiling_diagnoses_inert_pairing():
+    """The r4 defect in the simulator: with the workload's MEASURED ceiling
+    (6.3% vs the serve target 60) the fleet must pin at minReplicas and the
+    report must SAY the pairing is inert — simulating an ideal 100-ceiling
+    workload is how the defect stayed invisible."""
+    from k8s_gpu_hpa_tpu.simulate import run_scenario
+
+    report = run_scenario(
+        load_hpa("tpu-serve-hpa.yaml"),
+        scenario="spike",
+        duration=300.0,
+        saturated_pct=6.3,
+    )
+    assert "INERT PAIRING" in report.target_note
+    assert all(replicas == 1 for _, _, _, replicas, _ in report.timeline)
+    assert report.scale_up_latency is None
+    # every recorded sample is pinned at the ceiling once the spike lands
+    spiked = [rec for t, _, rec, _, _ in report.timeline if t > 90 and rec]
+    assert spiked and max(spiked) <= 6.4
+
+
+def test_saturated_ceiling_above_band_scales_and_reports_reachable():
+    from k8s_gpu_hpa_tpu.simulate import run_scenario
+
+    report = run_scenario(
+        load_hpa("tpu-serve-hpa.yaml"),
+        scenario="spike",
+        duration=300.0,
+        saturated_pct=85.0,
+    )
+    assert "target reachable" in report.target_note
+    assert report.scale_up_latency is not None
+    assert max(replicas for _, _, _, replicas, _ in report.timeline) == 4
