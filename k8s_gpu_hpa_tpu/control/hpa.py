@@ -147,6 +147,18 @@ class HPABehavior:
     )
 
 
+def signal_ceiling_clears_band(ceiling: float, target: float) -> bool:
+    """Can a workload whose gauge saturates at ``ceiling`` ever trigger
+    scale-up against ``target``?  Only STRICTLY above
+    ``target * (1 + TOLERANCE)`` — at exactly the band edge the controller
+    holds (``|ratio - 1| <= tolerance`` skips scaling).  THE reachability
+    predicate: bench.py's serve rung, the simulate CLI's ``--saturated-pct``
+    verdict, and the sizing sweep all call this one function so a boundary
+    fix or tolerance change can never leave them disagreeing (a ``>=`` here
+    once shipped a bench that exited 0 on an inert pairing)."""
+    return ceiling > target * (1.0 + HPAController.TOLERANCE)
+
+
 class ScalableTarget(Protocol):
     """The scale-subresource contract: read and mutate ``replicas``."""
 

@@ -150,18 +150,23 @@ def run_scenario(
     t_cross = None
     target_value = metrics[0].target_value
     if saturated_pct is not None:
-        # HPA tolerance: values within 10% of target never trigger — the
-        # ceiling must clear target*1.1 STRICTLY or the manifest can never
-        # scale this workload (bench.py's serve rung measures the same)
-        if saturated_pct > target_value * 1.1:
+        # the package's single reachability predicate (control/hpa.py):
+        # values within the controller's tolerance of target never trigger
+        from k8s_gpu_hpa_tpu.control.hpa import (
+            HPAController,
+            signal_ceiling_clears_band,
+        )
+
+        band = target_value * (1.0 + HPAController.TOLERANCE)
+        if signal_ceiling_clears_band(saturated_pct, target_value):
             report.target_note = (
                 f"signal ceiling {saturated_pct:g} clears the actionable "
-                f"band (> {target_value * 1.1:g}): target reachable"
+                f"band (> {band:g}): target reachable"
             )
         else:
             report.target_note = (
                 f"INERT PAIRING: signal ceiling {saturated_pct:g} cannot "
-                f"clear the actionable band (> {target_value * 1.1:g} "
+                f"clear the actionable band (> {band:g} "
                 f"needed) — this HPA will never scale this workload"
             )
     elapsed = 0.0

@@ -116,9 +116,9 @@ def test_serve_rung_closes_loop_min_to_max_on_measured_signal():
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["replicas_reached"] == 4
     assert result["scale_up_s"] > 0
-    # the synthetic-peak cpu stand-in saturates well above the 60 target,
-    # so reachability must hold here; on the real chip the same field is
-    # the shipped pairing's life-or-death number
+    # the synthetic-peak cpu stand-in saturates well above the target, so
+    # reachability must hold here; on the real chip the same field is the
+    # shipped pairing's life-or-death number
     assert result["target_reachable"] is True
     assert result["saturated_signal_pct"] > result["target_pct"]
     assert result["mode"] == "cpu_fallback"
@@ -162,3 +162,30 @@ def test_serve_reachability_boundary_is_strict():
     assert bench.serve_target_reachable(1.2) is True
     assert bench.serve_target_reachable(1.1) is False  # boundary: holds
     assert bench.serve_target_reachable(0.1) is False
+
+
+def test_shipped_target_sits_inside_the_measured_signal_range():
+    """The manifest contract the r4 defect violated: the shipped HPA target,
+    including the 10% tolerance band the controller needs cleared before it
+    scales, must sit BELOW the committed real-chip measurement of the
+    shipped workload's saturated signal.  The fixture is the r4 capture;
+    re-measure (tools/serve_sizing.py) and update BOTH when resizing."""
+    from k8s_gpu_hpa_tpu.control.hpa import signal_ceiling_clears_band
+    from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET
+
+    fixture = json.loads(
+        (Path(__file__).parent / "fixtures" / "serve_saturation.json").read_text()
+    )
+    measured = fixture["saturated_bw_pct"]
+    assert signal_ceiling_clears_band(measured, SERVE_BW_TARGET), (
+        f"shipped target {SERVE_BW_TARGET} is not reachable: the committed "
+        f"measurement says the workload saturates at {measured}% — the "
+        f"pairing would be inert"
+    )
+    # and the manifest on disk carries the same single-sourced number
+    import yaml
+
+    doc = yaml.safe_load((REPO / "deploy" / "tpu-serve-hpa.yaml").read_text())
+    assert float(doc["spec"]["metrics"][0]["object"]["target"]["value"]) == (
+        SERVE_BW_TARGET
+    )

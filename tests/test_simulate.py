@@ -158,8 +158,9 @@ def test_saturated_ceiling_diagnoses_inert_pairing():
     workload is how the defect stayed invisible."""
     from k8s_gpu_hpa_tpu.simulate import run_scenario
 
+    # the literal r4 numbers: ceiling 6.3 against tpu-test's 40 target
     report = run_scenario(
-        load_hpa("tpu-serve-hpa.yaml"),
+        load_hpa("tpu-test-hpa.yaml"),
         scenario="spike",
         duration=300.0,
         saturated_pct=6.3,

@@ -28,7 +28,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax  # noqa: E402
 
-from k8s_gpu_hpa_tpu.control.hpa import HPAController  # noqa: E402
+from k8s_gpu_hpa_tpu.control.hpa import signal_ceiling_clears_band  # noqa: E402
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
 
 GIB = 1 << 30
@@ -117,12 +117,12 @@ def main() -> None:
         except Exception as e:  # OOM, lowering failure: record and continue
             r = {"error": f"{type(e).__name__}: {e}"}
         sat = r.get("saturated_bw_pct")
-        band = args.target * (1.0 + HPAController.TOLERANCE)
         r |= {
             "config": label,
-            # the HPA acts above target*(1+tolerance): a workload whose
-            # saturated signal cannot clear that band never scales
-            "clears_target": bool(sat and sat >= band),
+            # the package's single reachability predicate (control/hpa.py)
+            "clears_target": bool(
+                sat and signal_ceiling_clears_band(sat, args.target)
+            ),
         }
         print(f"  {r}", file=sys.stderr, flush=True)
         results.append(r)
