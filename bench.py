@@ -52,9 +52,11 @@ beyond the headline:
   need.  This measures the metric-lag overshoot defect the reference
   narrates but never quantifies (README.md:123); the behavior stanza +
   1 s-fresh metrics should hold it at 0.
-- scale_down_budget: the declared target (BASELINE.md: p50 <= 270 s at 0
-  flaps, the configured 120 s window + two 50%/60s ramp periods + sync
-  slack); a regression fails the bench (nonzero exit after the JSON).
+- scale_down_budget: the declared per-mode target (BASELINE.md: p50 <=
+  255 s real_chip / 210 s cpu_fallback at 0 flaps, derived from the
+  configured 120 s window + one 50%/60s ramp period + sync slack, real
+  adding tunnel-stall margin); a regression fails the bench (nonzero exit
+  after the JSON).
 - kernel: dwell-measured TFLOP/s — ONE long uninterrupted on-device chain
   of matmuls, wall-clock timed, no RTT correction and no clamp, so
   achieved < peak by construction (mfu_pct is the honest MFU) — plus the

@@ -25,7 +25,7 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_gpu_hpa_tpu.ops.ring_attention import ring_attention_local
-from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
 @dataclass(frozen=True)
@@ -347,6 +347,235 @@ def decode_step(
         "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
     )[:, 0]
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+# ---- tensor-parallel serving (DP x TP over the (data, model) mesh) ---------
+#
+# A serving model whose KV cache + weights exceed one chip's HBM shards over
+# the mesh's model axis Megatron-style: attention heads and the MLP's d_ff
+# are column-sharded, the output projections row-sharded, so each layer
+# needs exactly TWO psums (after wo, after w2) and the attention itself is
+# local to the chip (each chip owns n_heads/m heads AND their slice of the
+# KV cache).  The batch shards over the data axis — independent serving
+# replicas inside one SPMD program.  The reference has no model code at all
+# (SURVEY.md §2c); this is the rebuild's multi-chip serving story, dry-run
+# compiled by the driver (__graft_entry__.dryrun_multichip).
+
+
+def tp_param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs for the TP layout of ``init_params``' pytree.  wqkv is
+    viewed as [d_model, 3, n_heads, head_dim] (see ``tp_params``) so the
+    packed q/k/v columns shard by HEAD, never splitting one head's slice
+    across chips."""
+    blk = {
+        "attn_norm": P(),
+        "wqkv": P(None, None, MODEL_AXIS, None),
+        "wo": P(MODEL_AXIS, None),
+        "mlp_norm": P(),
+        "w1": P(None, MODEL_AXIS),
+        "w2": P(MODEL_AXIS, None),
+    }
+    return {
+        "embed": P(),
+        "pos": P(),
+        "out_norm": P(),
+        "blocks": [dict(blk) for _ in range(cfg.n_layers)],
+    }
+
+
+def tp_params(params: dict, cfg: TransformerConfig, mesh: Mesh) -> dict:
+    """Re-layout + place a replicated parameter pytree for TP serving:
+    wqkv [d, 3d] -> [d, 3, n_heads, head_dim] (head-aligned sharding of the
+    packed projection), every leaf device_put with its TP sharding.  This is
+    the load-the-checkpoint-into-the-serving-topology step."""
+    specs = tp_param_specs(cfg)
+    out = {
+        "embed": params["embed"],
+        "pos": params["pos"],
+        "out_norm": params["out_norm"],
+        "blocks": [
+            dict(
+                blk,
+                wqkv=blk["wqkv"].reshape(
+                    cfg.d_model, 3, cfg.n_heads, cfg.head_dim
+                ),
+            )
+            for blk in params["blocks"]
+        ],
+    }
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        out,
+        specs,
+    )
+
+
+#: KV cache sharding for TP serving: batch over data, heads over model.
+_TP_CACHE_SPEC = P(None, DATA_AXIS, None, MODEL_AXIS, None)
+
+
+def _tp_validate(cfg: TransformerConfig, mesh: Mesh) -> None:
+    m = mesh.shape[MODEL_AXIS]
+    if cfg.n_heads % m or cfg.d_ff % m:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide the "
+            f"model axis ({m})"
+        )
+
+
+def init_tp_kv_cache(cfg: TransformerConfig, batch: int, mesh: Mesh) -> dict:
+    """KV cache sharded batch-over-data, heads-over-model: each chip holds
+    only its heads' slice — THE axis that lets a cache bigger than one
+    chip's HBM serve at all.  Allocated sharded from the start (jit with
+    out_shardings): materializing the full buffer on one device first would
+    OOM exactly the case this layout exists for."""
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    sharding = NamedSharding(mesh, _TP_CACHE_SPEC)
+    zeros = jax.jit(
+        lambda: jnp.zeros(shape, cfg.dtype), out_shardings=sharding
+    )
+    return {"k": zeros(), "v": zeros()}
+
+
+def _tp_block_tail(x, attn_flat, blk, cfg: TransformerConfig):
+    """The shared post-attention layer tail of TP serving (decode AND
+    prefill): row-sharded wo partial + psum, then column/row-sharded MLP +
+    psum — the layer's exactly-two collectives."""
+    partial_out = jnp.einsum(
+        "bsd,de->bse", attn_flat, blk["wo"], preferred_element_type=jnp.float32
+    )
+    x = x + lax.psum(partial_out, MODEL_AXIS).astype(cfg.dtype)
+    h = _rmsnorm(x, blk["mlp_norm"])
+    up = jnp.einsum(
+        "bsd,df->bsf", h, blk["w1"], preferred_element_type=jnp.float32
+    )
+    down = jnp.einsum(
+        "bsf,fd->bsd",
+        jax.nn.gelu(up).astype(cfg.dtype),
+        blk["w2"],
+        preferred_element_type=jnp.float32,
+    )
+    return x + lax.psum(down, MODEL_AXIS).astype(cfg.dtype)
+
+
+def make_tp_decode_step(mesh: Mesh, cfg: TransformerConfig):
+    """(tp_params, tokens[batch], tp_cache, pos) -> (logits[batch, vocab],
+    tp_cache): one autoregressive step, batch sharded over ``data``, heads +
+    d_ff sharded over ``model`` (two psums per layer)."""
+    _tp_validate(cfg, mesh)
+    m = mesh.shape[MODEL_AXIS]
+    param_specs = tp_param_specs(cfg)
+    cache_spec = {"k": _TP_CACHE_SPEC, "v": _TP_CACHE_SPEC}
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, P(DATA_AXIS), cache_spec, P()),
+        out_specs=(P(DATA_AXIS), cache_spec),
+        check_vma=False,
+    )
+    def step(params, tokens, cache, pos):
+        b = tokens.shape[0]  # local batch (data shard)
+        lh = cfg.n_heads // m  # local heads (model shard)
+        x = params["embed"][tokens][:, None, :] + params["pos"][pos][
+            None, None, :
+        ].astype(cfg.dtype)
+        new_k, new_v = [], []
+        for i, blk in enumerate(params["blocks"]):
+            h = _rmsnorm(x, blk["attn_norm"])
+            # local projection: this chip's heads only ([d, 3, lh, hd])
+            qkv = jnp.einsum(
+                "bsd,dthk->bsthk", h, blk["wqkv"],
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
+            q, k, v = qkv[:, 0, 0], qkv[:, 0, 1], qkv[:, 0, 2]  # [b, lh, hd]
+            shape = (b, 1, lh, cfg.head_dim)
+            k_cache = lax.dynamic_update_slice(
+                cache["k"][i], k.reshape(shape), (0, pos, 0, 0)
+            )
+            v_cache = lax.dynamic_update_slice(
+                cache["v"][i], v.reshape(shape), (0, pos, 0, 0)
+            )
+            new_k.append(k_cache)
+            new_v.append(v_cache)
+            # attention over the LOCAL heads' cache slice — no communication
+            s = jnp.einsum(
+                "bhd,bthd->bht", q, k_cache, preferred_element_type=jnp.float32
+            ) / (cfg.head_dim**0.5)
+            s = jnp.where(jnp.arange(cfg.max_seq)[None, None, :] <= pos, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum(
+                "bht,bthd->bhd", p, v_cache.astype(jnp.float32)
+            ).astype(cfg.dtype)
+            # shared tail: row-sharded wo partial + psum, MLP + psum
+            x = _tp_block_tail(
+                x, attn.reshape(b, 1, lh * cfg.head_dim), blk, cfg
+            )
+        x = _rmsnorm(x, params["out_norm"])
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+        )[:, 0]
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    # donate the cache: the serving loop discards the input cache every
+    # step, and without aliasing each step would hold TWO full cache shards
+    # per chip — the memory this path exists to economize
+    return jax.jit(step, donate_argnums=(2,))
+
+
+def make_tp_prefill(mesh: Mesh, cfg: TransformerConfig):
+    """(tp_params, tokens[batch, prompt_len], tp_cache) -> (last-position
+    logits, filled tp_cache): the admission path of TP serving.  Attention
+    runs on each chip's LOCAL heads — the fused flash kernel when the shape
+    sits in its envelope (head_dim is unchanged by head-sharding) — and the
+    same two psums per layer as decode stitch d_model back together."""
+    from k8s_gpu_hpa_tpu.ops.flash_attention import flash_attention
+
+    _tp_validate(cfg, mesh)
+    m = mesh.shape[MODEL_AXIS]
+    param_specs = tp_param_specs(cfg)
+    cache_spec = {"k": _TP_CACHE_SPEC, "v": _TP_CACHE_SPEC}
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, P(DATA_AXIS), cache_spec),
+        out_specs=(P(DATA_AXIS), cache_spec),
+        check_vma=False,
+    )
+    def prefill_fn(params, tokens, cache):
+        b, plen = tokens.shape
+        lh = cfg.n_heads // m
+        pos = jnp.arange(plen)
+        x = params["embed"][tokens] + params["pos"][pos][None, :, :].astype(
+            cfg.dtype
+        )
+        new_k, new_v = [], []
+        for i, blk in enumerate(params["blocks"]):
+            h = _rmsnorm(x, blk["attn_norm"])
+            qkv = jnp.einsum(
+                "bsd,dthk->bsthk", h, blk["wqkv"],
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b,plen,lh,hd]
+            attn = flash_attention(q, k, v, causal=True)
+            new_k.append(
+                lax.dynamic_update_slice(cache["k"][i], k, (0, 0, 0, 0))
+            )
+            new_v.append(
+                lax.dynamic_update_slice(cache["v"][i], v, (0, 0, 0, 0))
+            )
+            # shared tail: row-sharded wo partial + psum, MLP + psum
+            x = _tp_block_tail(
+                x, attn.reshape(b, plen, lh * cfg.head_dim), blk, cfg
+            )
+        x = _rmsnorm(x[:, -1:], params["out_norm"])
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+        )[:, 0]
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    return jax.jit(prefill_fn, donate_argnums=(2,))
 
 
 def make_forward(mesh: Mesh, cfg: TransformerConfig):
