@@ -1,0 +1,154 @@
+"""Pipeline parallelism (PP): a layer-sharded residual-MLP stack with
+GPipe-style microbatching over the device mesh.
+
+The remaining axis of the parallelism alphabet (dp / tp / sp-ring / ep /
+**pp**): layers are sharded over the mesh's model axis — each chip holds
+``n_layers / p`` consecutive layers' weights, the layout for models whose
+WEIGHTS exceed one chip's HBM — and microbatches stream through the stages,
+activations hopping one ``ppermute`` per step.  The schedule is the classic
+``p + n_micro - 1`` step pipeline with bubble fraction
+``(p - 1) / (p + n_micro - 1)``: every stage computes every step (static
+shapes, no data-dependent control flow — bubble steps compute on garbage
+registers and their results are simply never recorded), which is exactly
+how an SPMD pipeline keeps XLA happy.
+
+Everything is ``lax.scan`` (never ``fori_loop``), so the whole pipeline is
+reverse-mode differentiable: scan's backward replays the schedule in
+reverse and ``ppermute`` transposes to the reverse permutation — training
+through the pipeline needs no custom machinery.
+
+The reference has no model code at all (SURVEY.md §2c); the driver's
+multi-chip dryrun certifies this axis alongside the others
+(__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    d_model: int = 128
+    d_ff: int = 256
+    n_layers: int = 8
+    dtype: object = jnp.bfloat16
+
+
+def init_pp_params(key: jax.Array, cfg: PipelineConfig) -> dict:
+    """Layer-stacked weights ([n_layers, ...]), the shape that shards over
+    the stage axis with ``P(MODEL_AXIS, None, None)``."""
+    k1, k2 = jax.random.split(key)
+    scale = 1.0 / (cfg.d_model**0.5)
+    return {
+        "w1": (
+            jax.random.normal(
+                k1, (cfg.n_layers, cfg.d_model, cfg.d_ff), jnp.float32
+            )
+            * scale
+        ).astype(cfg.dtype),
+        "w2": (
+            jax.random.normal(
+                k2, (cfg.n_layers, cfg.d_ff, cfg.d_model), jnp.float32
+            )
+            * (1.0 / (cfg.d_ff**0.5))
+        ).astype(cfg.dtype),
+    }
+
+
+def _layer(h, ws, dtype):
+    w1, w2 = ws
+    up = jnp.einsum("bd,df->bf", h, w1, preferred_element_type=jnp.float32)
+    down = jnp.einsum(
+        "bf,fd->bd",
+        jax.nn.gelu(up).astype(dtype),
+        w2,
+        preferred_element_type=jnp.float32,
+    )
+    return (h + down.astype(dtype), None)
+
+
+def pp_forward_reference(params: dict, cfg: PipelineConfig, x: jax.Array):
+    """Single-device oracle: the same stack, all layers sequentially."""
+    h, _ = lax.scan(
+        lambda h, ws: _layer(h, ws, cfg.dtype), x, (params["w1"], params["w2"])
+    )
+    return h
+
+
+def make_pp_forward(mesh: Mesh, cfg: PipelineConfig, n_micro: int = 4):
+    """(params, x[batch, d_model]) -> [batch, d_model]: the stack with
+    layers sharded over the model axis (pipeline stages) and the batch
+    sharded over data, streamed in ``n_micro`` microbatches."""
+    p = mesh.shape[MODEL_AXIS]
+    if cfg.n_layers % p:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} must be divisible by the model axis "
+            f"size ({p})"
+        )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            {"w1": P(MODEL_AXIS, None, None), "w2": P(MODEL_AXIS, None, None)},
+            P(DATA_AXIS, None),
+        ),
+        out_specs=P(DATA_AXIS, None),
+        check_vma=False,
+    )
+    def fwd(params, x):
+        stage = lax.axis_index(MODEL_AXIS)
+        b = x.shape[0]  # local (data-shard) batch
+        if b % n_micro:
+            raise ValueError(
+                f"local batch {b} must be divisible by n_micro ({n_micro})"
+            )
+        mb = b // n_micro
+        micro = x.reshape(n_micro, mb, cfg.d_model)
+        n_steps = p + n_micro - 1
+        perm = [(i, (i + 1) % p) for i in range(p)]
+
+        def local_stack(h):
+            h, _ = lax.scan(
+                lambda h, ws: _layer(h, ws, cfg.dtype),
+                h,
+                (params["w1"], params["w2"]),
+            )
+            return h
+
+        def step(carry, t):
+            cur, out = carry
+            # stage 0 ingests microbatch t (bubble steps re-feed the last
+            # microbatch; their results are never recorded)
+            feed = micro[jnp.clip(t, 0, n_micro - 1)]
+            cur = jnp.where(stage == 0, feed, cur)
+            y = local_stack(cur)
+            # the LAST stage's y at step t is microbatch t-(p-1), finished
+            idx = t - (p - 1)
+            recorded = lax.dynamic_update_slice(
+                out, y[None].astype(out.dtype), (jnp.clip(idx, 0, n_micro - 1), 0, 0)
+            )
+            out = jnp.where((stage == p - 1) & (idx >= 0), recorded, out)
+            # activations hop one stage forward
+            cur = lax.ppermute(y, MODEL_AXIS, perm)
+            return (cur, out), None
+
+        cur0 = jnp.zeros((mb, cfg.d_model), x.dtype)
+        out0 = jnp.zeros_like(micro)
+        (_, out), _ = lax.scan(step, (cur0, out0), jnp.arange(n_steps))
+        # only the last stage holds real outputs (zeros elsewhere): the psum
+        # replicates them across the pipe axis so every chip returns the
+        # same [batch, d] block the out_spec promises
+        out = lax.psum(out, MODEL_AXIS)
+        return out.reshape(b, cfg.d_model)
+
+    return jax.jit(fwd)

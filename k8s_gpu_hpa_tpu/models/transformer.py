@@ -418,8 +418,8 @@ def _tp_validate(cfg: TransformerConfig, mesh: Mesh) -> None:
     m = mesh.shape[MODEL_AXIS]
     if cfg.n_heads % m or cfg.d_ff % m:
         raise ValueError(
-            f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide the "
-            f"model axis ({m})"
+            f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must be divisible "
+            f"by the model axis size ({m})"
         )
 
 

@@ -323,5 +323,5 @@ def test_tp_rejects_non_dividing_shapes():
 
     cfg = TransformerConfig(d_model=64, n_heads=3, n_layers=1, d_ff=128, max_seq=16)
     mesh = make_mesh(n_devices=8, model_parallelism=4)
-    with pytest.raises(ValueError, match="must divide"):
+    with pytest.raises(ValueError, match="divisible"):
         make_tp_decode_step(mesh, cfg)
