@@ -1,0 +1,149 @@
+"""Expert-parallel MoE load generator: the ``all_to_all`` rung of the ladder.
+
+Every other multi-chip rung exercises ring- or tree-shaped collectives
+(allreduce: psum/all_gather/ppermute; ringattn/llm: ppermute).  A
+mixture-of-experts layer is the workload whose hot collective is
+``all_to_all`` — all-pairs traffic that loads the ICI fabric's bisection
+instead of a neighbor ring — and its duty signature is what the L2→L5
+pipeline sees from a production MoE serving/training pod.  Built on
+``models/moe.py`` (experts sharded over the mesh's model axis, switch
+top-1 routing, fixed capacity); ``ffns_per_burst`` layers chain inside one
+jitted ``lax.fori_loop`` so dispatch overhead doesn't pollute the
+measurement (the same amortization every generator uses).
+
+Selectable in the multi-host container via ``WORKLOAD=moe``
+(loadgen/multihost.py); the reference has no analog of any communicating
+workload (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_hpa_tpu.models.moe import MoEConfig, _capacity, init_moe_params
+from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+
+@dataclass
+class MoEStats:
+    bursts: int
+    tokens_routed: int
+    tokens_per_sec: float
+    #: all_to_all bytes each chip exchanges per burst (both directions,
+    #: (m-1)/m of the dispatch buffer leaves the chip each way)
+    a2a_bytes_per_burst: float
+    a2a_gbps: float  # per-chip all_to_all bandwidth over busy time
+    seconds: float
+
+
+class MoELoadGen:
+    """Busy-loop of expert-parallel MoE FFN bursts over the mesh."""
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        d_model: int = 512,
+        d_ff: int = 2048,
+        n_experts: int | None = None,
+        tokens_per_shard: int = 1024,
+        ffns_per_burst: int = 8,
+        dtype=jnp.bfloat16,
+    ):
+        # EP wants a model axis: default to 2-way (or pure-local on 1 device)
+        if mesh is None:
+            n = len(jax.devices())
+            mesh = make_mesh(model_parallelism=2 if n % 2 == 0 and n > 1 else 1)
+        self.mesh = mesh
+        m = mesh.shape[MODEL_AXIS]
+        self.cfg = MoEConfig(
+            d_model=d_model,
+            d_ff=d_ff,
+            # two experts per model-axis chip by default (2*m): enough
+            # routing spread that most tokens cross the fabric, and the
+            # dispatch buffer the a2a accounting sizes from is n_experts
+            # buckets wide
+            n_experts=n_experts if n_experts is not None else max(2 * m, 2),
+            dtype=dtype,
+        )
+        self.tokens_per_shard = tokens_per_shard
+        self.ffns_per_burst = ffns_per_burst
+        self._params = jax.device_put(
+            init_moe_params(jax.random.PRNGKey(0), self.cfg),
+            NamedSharding(mesh, P()),
+        )
+        n_data = mesh.shape[DATA_AXIS]
+        self._x = jax.device_put(
+            jax.random.normal(
+                jax.random.PRNGKey(1),
+                (tokens_per_shard * n_data, d_model),
+                jnp.float32,
+            ).astype(dtype)
+            * 0.5,
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+        )
+        from k8s_gpu_hpa_tpu.models.moe import make_ep_moe_ffn
+
+        ffn = make_ep_moe_ffn(mesh, self.cfg)
+
+        @jax.jit
+        def burst(params, x):
+            def one(i, h):
+                out = ffn(params, h)
+                h = h + out
+                # RMS re-normalize so the residual chain never overflows
+                # bf16 across an unbounded run (and defeats CSE per round)
+                scale = lax.rsqrt(
+                    jnp.mean(jnp.square(h.astype(jnp.float32))) + 1e-6
+                ) * (1.0 + 1e-6 * i.astype(jnp.float32))
+                return (h.astype(jnp.float32) * scale).astype(dtype)
+
+            return lax.fori_loop(0, self.ffns_per_burst, one, x)
+
+        self._burst = burst
+        self._bursts = 0
+        self._busy = 0.0
+
+    def warmup(self) -> None:
+        self._burst(self._params, self._x).block_until_ready()
+
+    def step(self) -> float:
+        t0 = time.perf_counter()
+        self._x = self._burst(self._params, self._x)
+        self._x.block_until_ready()
+        dt = time.perf_counter() - t0
+        self._busy += dt
+        self._bursts += 1
+        return dt
+
+    def stats(self) -> MoEStats:
+        m = self.mesh.shape[MODEL_AXIS]
+        cap = _capacity(self.tokens_per_shard, self.cfg)
+        buf_bytes = (
+            self.cfg.n_experts * cap * self.cfg.d_model
+            * jnp.dtype(self.cfg.dtype).itemsize
+        )
+        # per chip, per FFN: (m-1)/m of the dispatch buffer leaves on the
+        # forward all_to_all and the same returns on the reverse
+        per_burst = 2.0 * buf_bytes * (m - 1) / m * self.ffns_per_burst
+        tokens = (
+            self.tokens_per_shard
+            * self.mesh.shape[DATA_AXIS]
+            * self.ffns_per_burst
+            * self._bursts
+        )
+        return MoEStats(
+            bursts=self._bursts,
+            tokens_routed=tokens,
+            tokens_per_sec=tokens / self._busy if self._busy else 0.0,
+            a2a_bytes_per_burst=per_burst,
+            a2a_gbps=(
+                per_burst * self._bursts / self._busy / 1e9 if self._busy else 0.0
+            ),
+            seconds=self._busy,
+        )

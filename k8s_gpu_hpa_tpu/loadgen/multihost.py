@@ -165,6 +165,29 @@ def main() -> None:
                 f"tok/s={s.tokens_per_sec:.0f} busy={s.seconds:.1f}s"
             )
 
+    elif workload == "moe":
+        # expert-parallel rung: all_to_all dispatch to sharded experts —
+        # the all-pairs ICI traffic no ring-shaped rung produces.  The rung
+        # needs a model axis to communicate over, so it builds its own mesh
+        # (MODEL_PARALLELISM env, else the generator's even-split default)
+        # instead of the slice's default pure-DP shape.
+        from k8s_gpu_hpa_tpu.loadgen.moe import MoELoadGen
+
+        mp = int(os.environ.get("MODEL_PARALLELISM", "0"))
+        gen = MoELoadGen(
+            mesh=make_mesh(model_parallelism=mp) if mp else None,
+            d_model=int(os.environ.get("D_MODEL", "512")),
+            d_ff=int(os.environ.get("D_FF", "2048")),
+            tokens_per_shard=int(os.environ.get("TOKENS_PER_SHARD", "1024")),
+        )
+        mesh = gen.mesh  # the banner must print the topology actually used
+
+        def report(s):
+            return (
+                f"bursts={s.bursts} tok/s={s.tokens_per_sec:.0f} "
+                f"a2a={s.a2a_gbps:.2f}GB/s busy={s.seconds:.1f}s"
+            )
+
     elif workload == "ringattn":
         # long-context rung: sequence-parallel attention over the slice's ring
         from k8s_gpu_hpa_tpu.loadgen.ringattn import RingAttentionLoadGen
@@ -230,7 +253,10 @@ def main() -> None:
     signal.signal(signal.SIGINT, _terminate)  # Ctrl-C saves the final checkpoint too
 
     last_report = time.perf_counter()
-    last_ckpt_step = gen.stats().steps
+    # only checkpointable generators (llm) have .steps; the collective rungs
+    # count bursts/rounds — touching .steps unconditionally crashed every
+    # non-llm workload at startup (caught driving WORKLOAD=moe end-to-end)
+    last_ckpt_step = gen.stats().steps if manager is not None else 0
     while True:
         if stopping:
             if manager is not None and gen.stats().steps > last_ckpt_step:

@@ -28,7 +28,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
