@@ -1649,7 +1649,11 @@ def wait_for_device(log, attempts: int | None = None, probe_timeout: float = 90.
     import subprocess
 
     if attempts is None:
-        attempts = int(os.environ.get("BENCH_DEVICE_PROBE_ATTEMPTS", "8"))
+        # 5 x (90 s probe + 60 s backoff) ~ 12 min: rides out transient
+        # blips without eating the driver's whole window when the tunnel is
+        # down for hours (r4's outage lasted 10+ h — more retries only
+        # delayed the honest cpu_fallback run)
+        attempts = int(os.environ.get("BENCH_DEVICE_PROBE_ATTEMPTS", "5"))
     for attempt in range(1, attempts + 1):
         try:
             probe = subprocess.run(
@@ -1808,6 +1812,27 @@ def main() -> None:
                 continue
             log(f"  scale-up latency: {result['scale_up']:.1f}s")
             trials.append(result)
+            if len(trials) == 1 and N_TRIALS > 1:
+                # (len(trials), not the loop index: when trial 1 wedges and
+                # trial 2 produces the first number, that one still prints)
+                # provisional contract line the moment ANY headline number
+                # exists: a driver timeout during trials 2-3 (each ~up to
+                # 10 min of drain) must not erase trial 1.  The final lines
+                # replace it; "provisional" marks the sample size.
+                out.update(
+                    {
+                        "metric": "hpa_scale_up_p50_latency",
+                        "value": round(result["scale_up"], 2),
+                        "unit": "s",
+                        "vs_baseline": round(BUDGET_S / result["scale_up"], 3),
+                        "mode": mode,
+                        "trials_completed": 1,
+                        "provisional": True,
+                    }
+                )
+                if TIME_SCALE != 1.0:
+                    out["time_scale"] = TIME_SCALE
+                emit(print_line=True)
         if not trials:
             raise RuntimeError("no trial completed")
 
@@ -1844,6 +1869,7 @@ def main() -> None:
                 max(t["peak_sustained_tflops"] for t in trials), 1
             )
         }
+        out.pop("provisional", None)  # the full-trials record supersedes it
         out.update(
             {
                 "metric": "hpa_scale_up_p50_latency",

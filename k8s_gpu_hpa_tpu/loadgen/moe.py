@@ -26,7 +26,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from k8s_gpu_hpa_tpu.models.moe import MoEConfig, _capacity, init_moe_params
+from k8s_gpu_hpa_tpu.models.moe import (
+    MoEConfig,
+    _capacity,
+    init_moe_params,
+    make_ep_moe_ffn,
+)
 from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
 
 
@@ -87,8 +92,6 @@ class MoELoadGen:
             * 0.5,
             NamedSharding(mesh, P(DATA_AXIS, None)),
         )
-        from k8s_gpu_hpa_tpu.models.moe import make_ep_moe_ffn
-
         ffn = make_ep_moe_ffn(mesh, self.cfg)
 
         @jax.jit
