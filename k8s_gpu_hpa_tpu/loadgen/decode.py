@@ -128,9 +128,16 @@ class DecodeLoadGen:
         dtype=jnp.bfloat16,
         window: float = 10.0,
         prefill_len: int = 0,
+        #: > 1 serves TENSOR-PARALLEL across the local chips (Megatron
+        #: layout, models/transformer.py: heads + d_ff sharded over the
+        #: model axis, head-sharded KV cache, two psums per layer) — the
+        #: multi-chip serving pod whose model/cache exceeds one chip's HBM.
+        #: The burst stays one dispatch (make_tp_decode_burst).
+        model_parallelism: int = 1,
     ):
         self.window = window
         self.prefill_len = prefill_len
+        self.model_parallelism = model_parallelism
         self.cfg = TransformerConfig(
             d_model=d_model,
             n_heads=n_heads,
@@ -145,11 +152,24 @@ class DecodeLoadGen:
                 TPU_TOKENS_PER_BURST if jax.default_backend() == "tpu" else 4
             )
         self.tokens_per_burst = tokens_per_burst
+        if prefill_len > 0 and prefill_len + tokens_per_burst >= max_seq:
+            # ValueError, not assert: prefill_len arrives via PREFILL_LEN
+            # from the pod env, and an out-of-range value under python -O
+            # would silently clamp cache writes instead of failing
+            raise ValueError(
+                f"prefill_len {prefill_len} + tokens_per_burst "
+                f"{tokens_per_burst} must stay inside max_seq {max_seq}"
+            )
+        cfg = self.cfg
+        if model_parallelism > 1:
+            self._init_tp(model_parallelism)
+            self._finish_init()
+            return
+        self._mesh = None
         self._params = init_params(jax.random.PRNGKey(0), self.cfg)
         self._cache = init_kv_cache(self.cfg, batch)
         self._tokens = jnp.zeros((batch,), jnp.int32)
         self._pos = jnp.int32(0)
-        cfg = self.cfg
 
         def decode_chain(params, tokens, cache, pos):
             def body(_, carry):
@@ -168,14 +188,6 @@ class DecodeLoadGen:
             # the real serving shape: each burst admits a fresh request batch
             # (prefill the prompt with the fused causal pass — MXU-bound)
             # then decodes from it (HBM-bound) — one dispatch for both phases
-            # ValueError, not assert: prefill_len arrives via PREFILL_LEN
-            # from the pod env, and an out-of-range value under python -O
-            # would silently clamp cache writes instead of failing
-            if prefill_len + tokens_per_burst >= max_seq:
-                raise ValueError(
-                    f"prefill_len {prefill_len} + tokens_per_burst "
-                    f"{tokens_per_burst} must stay inside max_seq {max_seq}"
-                )
             self._prompt = jax.random.randint(
                 jax.random.PRNGKey(2), (batch, prefill_len), 0, self.cfg.vocab,
                 jnp.int32,
@@ -195,6 +207,66 @@ class DecodeLoadGen:
                 return decode_chain(params, tokens, cache, pos)
 
         self._burst = jax.jit(burst)
+        self._finish_init()
+
+    def _init_tp(self, model_parallelism: int) -> None:
+        """Tensor-parallel serving state: sharded params/cache + the
+        one-dispatch TP burst (and TP prefill when configured)."""
+        from k8s_gpu_hpa_tpu.models.transformer import (
+            init_tp_kv_cache,
+            make_tp_decode_burst,
+            make_tp_prefill,
+            tp_params,
+        )
+        from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, make_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.cfg
+        mesh = make_mesh(model_parallelism=model_parallelism)
+        self._mesh = mesh
+        if self.batch % mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"batch {self.batch} must be divisible by the data axis "
+                f"({mesh.shape[DATA_AXIS]})"
+            )
+        self._params = tp_params(
+            init_params(jax.random.PRNGKey(0), cfg), cfg, mesh
+        )
+        self._cache = init_tp_kv_cache(cfg, self.batch, mesh)
+        data_sharded = NamedSharding(mesh, P(DATA_AXIS))
+        self._tokens = jax.device_put(
+            jnp.zeros((self.batch,), jnp.int32), data_sharded
+        )
+        self._pos = jnp.int32(0)
+        tp_burst = make_tp_decode_burst(mesh, cfg, self.tokens_per_burst)
+        if self.prefill_len > 0:
+            self._prompt = jax.device_put(
+                jax.random.randint(
+                    jax.random.PRNGKey(2),
+                    (self.batch, self.prefill_len),
+                    0,
+                    cfg.vocab,
+                    jnp.int32,
+                ),
+                NamedSharding(mesh, P(DATA_AXIS, None)),
+            )
+            tp_prefill = make_tp_prefill(mesh, cfg)
+            plen = self.prefill_len
+
+            def tp_run(params, tokens, cache, _pos):
+                logits, cache = tp_prefill(params, self._prompt, cache)
+                first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return tp_burst(params, first, cache, jnp.int32(plen))
+
+            # the outer jit fuses prefill + argmax + chained decode into ONE
+            # dispatch (shard_maps compose under tracing), same as the
+            # single-device burst — the amortization the burst exists for
+            self._burst = jax.jit(tp_run, donate_argnums=(2,))
+        else:
+            self._prompt = None
+            self._burst = tp_burst
+
+    def _finish_init(self) -> None:
         self._steps = 0
         self._busy = 0.0
         #: (t, busy_seconds) recent bursts, pruned to the window.  Guarded:
@@ -206,7 +278,21 @@ class DecodeLoadGen:
         self._param_bytes = sum(
             arr.size * arr.dtype.itemsize for arr in jax.tree.leaves(self._params)
         )
-        self.peak_hbm_gbps = peak_hbm_gbps_for(jax.devices()[0])
+        peak = peak_hbm_gbps_for(jax.devices()[0])
+        #: weight reads multiply by the DATA-axis replica count: TP shards
+        #: params over the model axis only, so each data replica streams its
+        #: own copy every step — counting them once would under-report a
+        #: saturated multi-replica pod (the inert-signal trap again)
+        self._param_stream_factor = 1
+        if self._mesh is not None:
+            from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS
+
+            self._param_stream_factor = self._mesh.shape[DATA_AXIS]
+            if peak is not None:
+                # aggregate peak: per-chip peak x mesh size (the signal
+                # stays "fraction of what THIS pod's chips can move")
+                peak = peak * self._mesh.size
+        self.peak_hbm_gbps = peak
 
     def warmup(self) -> None:
         self._run_burst()
@@ -257,13 +343,14 @@ class DecodeLoadGen:
         # ``window`` seconds instead of freezing at its historical average
         # (the load-insensitivity trap: busy-time rates are ~constant for a
         # memory-bound kernel regardless of offered demand).
-        bytes_per_burst = self.tokens_per_burst * (cache_bytes + self._param_bytes)
+        param_stream = self._param_bytes * self._param_stream_factor
+        bytes_per_burst = self.tokens_per_burst * (cache_bytes + param_stream)
         if self.prefill_len:
             # the burst's prefill phase: one weight read (the fused causal
             # pass touches every layer once) + the KV-cache writes for the
             # prompt positions (prefill_len of the max_seq-padded cache)
             bytes_per_burst += (
-                self._param_bytes
+                param_stream
                 + cache_bytes * self.prefill_len // self.cfg.max_seq
             )
         if first_t is not None:
@@ -325,6 +412,9 @@ def main() -> None:
         n_heads=int(os.environ.get("N_HEADS", "8")),
         n_layers=int(os.environ.get("N_LAYERS", "4")),
         prefill_len=int(os.environ.get("PREFILL_LEN", "0")),
+        # > 1: tensor-parallel serving across the pod's chips (multi-chip
+        # slice topologies; the model/cache shards Megatron-style)
+        model_parallelism=int(os.environ.get("MODEL_PARALLELISM", "1")),
     )
     gen.warmup()
     knob = IntensityKnob()

@@ -458,6 +458,52 @@ def _tp_block_tail(x, attn_flat, blk, cfg: TransformerConfig):
     return x + lax.psum(down, MODEL_AXIS).astype(cfg.dtype)
 
 
+def _tp_decode_body(params, cfg: TransformerConfig, m: int, tokens, cache, pos):
+    """One TP decode step's LOCAL computation (call inside a shard_map over
+    the model axis): local-head attention against the cache shard + the
+    two-psum layer tail.  Shared by the single-step path and the chained
+    burst (the serving generator's dispatch-amortized loop)."""
+    b = tokens.shape[0]  # local batch (data shard)
+    lh = cfg.n_heads // m  # local heads (model shard)
+    x = params["embed"][tokens][:, None, :] + params["pos"][pos][
+        None, None, :
+    ].astype(cfg.dtype)
+    new_k, new_v = [], []
+    for i, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["attn_norm"])
+        # local projection: this chip's heads only ([d, 3, lh, hd])
+        qkv = jnp.einsum(
+            "bsd,dthk->bsthk", h, blk["wqkv"],
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        q, k, v = qkv[:, 0, 0], qkv[:, 0, 1], qkv[:, 0, 2]  # [b, lh, hd]
+        shape = (b, 1, lh, cfg.head_dim)
+        k_cache = lax.dynamic_update_slice(
+            cache["k"][i], k.reshape(shape), (0, pos, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            cache["v"][i], v.reshape(shape), (0, pos, 0, 0)
+        )
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        # attention over the LOCAL heads' cache slice — no communication
+        s = jnp.einsum(
+            "bhd,bthd->bht", q, k_cache, preferred_element_type=jnp.float32
+        ) / (cfg.head_dim**0.5)
+        s = jnp.where(jnp.arange(cfg.max_seq)[None, None, :] <= pos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum(
+            "bht,bthd->bhd", p, v_cache.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        # shared tail: row-sharded wo partial + psum, MLP + psum
+        x = _tp_block_tail(x, attn.reshape(b, 1, lh * cfg.head_dim), blk, cfg)
+    x = _rmsnorm(x, params["out_norm"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )[:, 0]
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
 def make_tp_decode_step(mesh: Mesh, cfg: TransformerConfig):
     """(tp_params, tokens[batch], tp_cache, pos) -> (logits[batch, vocab],
     tp_cache): one autoregressive step, batch sharded over ``data``, heads +
@@ -475,52 +521,48 @@ def make_tp_decode_step(mesh: Mesh, cfg: TransformerConfig):
         check_vma=False,
     )
     def step(params, tokens, cache, pos):
-        b = tokens.shape[0]  # local batch (data shard)
-        lh = cfg.n_heads // m  # local heads (model shard)
-        x = params["embed"][tokens][:, None, :] + params["pos"][pos][
-            None, None, :
-        ].astype(cfg.dtype)
-        new_k, new_v = [], []
-        for i, blk in enumerate(params["blocks"]):
-            h = _rmsnorm(x, blk["attn_norm"])
-            # local projection: this chip's heads only ([d, 3, lh, hd])
-            qkv = jnp.einsum(
-                "bsd,dthk->bsthk", h, blk["wqkv"],
-                preferred_element_type=jnp.float32,
-            ).astype(cfg.dtype)
-            q, k, v = qkv[:, 0, 0], qkv[:, 0, 1], qkv[:, 0, 2]  # [b, lh, hd]
-            shape = (b, 1, lh, cfg.head_dim)
-            k_cache = lax.dynamic_update_slice(
-                cache["k"][i], k.reshape(shape), (0, pos, 0, 0)
-            )
-            v_cache = lax.dynamic_update_slice(
-                cache["v"][i], v.reshape(shape), (0, pos, 0, 0)
-            )
-            new_k.append(k_cache)
-            new_v.append(v_cache)
-            # attention over the LOCAL heads' cache slice — no communication
-            s = jnp.einsum(
-                "bhd,bthd->bht", q, k_cache, preferred_element_type=jnp.float32
-            ) / (cfg.head_dim**0.5)
-            s = jnp.where(jnp.arange(cfg.max_seq)[None, None, :] <= pos, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum(
-                "bht,bthd->bhd", p, v_cache.astype(jnp.float32)
-            ).astype(cfg.dtype)
-            # shared tail: row-sharded wo partial + psum, MLP + psum
-            x = _tp_block_tail(
-                x, attn.reshape(b, 1, lh * cfg.head_dim), blk, cfg
-            )
-        x = _rmsnorm(x, params["out_norm"])
-        logits = jnp.einsum(
-            "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
-        )[:, 0]
-        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        return _tp_decode_body(params, cfg, m, tokens, cache, pos)
 
     # donate the cache: the serving loop discards the input cache every
     # step, and without aliasing each step would hold TWO full cache shards
     # per chip — the memory this path exists to economize
     return jax.jit(step, donate_argnums=(2,))
+
+
+def make_tp_decode_burst(
+    mesh: Mesh, cfg: TransformerConfig, tokens_per_burst: int
+):
+    """(tp_params, tokens[batch], tp_cache, pos) -> (tokens, tp_cache, pos):
+    ``tokens_per_burst`` greedy decode steps chained inside ONE dispatch
+    (``lax.fori_loop`` inside the shard_map) — the dispatch amortization the
+    serving load generator needs over a high-RTT link, on the TP layout.
+    Greedy semantics identical to the single-device decode chain
+    (loadgen/decode.py): argmax feeds the next step, position wraps before
+    max_seq."""
+    _tp_validate(cfg, mesh)
+    m = mesh.shape[MODEL_AXIS]
+    param_specs = tp_param_specs(cfg)
+    cache_spec = {"k": _TP_CACHE_SPEC, "v": _TP_CACHE_SPEC}
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, P(DATA_AXIS), cache_spec, P()),
+        out_specs=(P(DATA_AXIS), cache_spec, P()),
+        check_vma=False,
+    )
+    def burst(params, tokens, cache, pos):
+        def body(_, carry):
+            tokens, cache, pos = carry
+            # logits are replicated over the model axis (they come from x
+            # after the psums), so the greedy argmax is consistent per shard
+            logits, cache = _tp_decode_body(params, cfg, m, tokens, cache, pos)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, cache, (pos + 1) % (cfg.max_seq - 1)
+
+        return lax.fori_loop(0, tokens_per_burst, body, (tokens, cache, pos))
+
+    return jax.jit(burst, donate_argnums=(2,))
 
 
 def make_tp_prefill(mesh: Mesh, cfg: TransformerConfig):
