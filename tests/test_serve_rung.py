@@ -189,3 +189,20 @@ def test_shipped_target_sits_inside_the_measured_signal_range():
     assert float(doc["spec"]["metrics"][0]["object"]["target"]["value"]) == (
         SERVE_BW_TARGET
     )
+
+
+def test_serve_budget_failure_fires_only_on_real_chip_inert_measurement():
+    """The bench-failing verdict: a MEASURED inert pairing on the real chip
+    exits nonzero; cpu stand-ins, reachable pairings, and rungs that errored
+    before measuring (no reachability fields) pass through."""
+    import bench
+
+    inert = {"target_reachable": False, "saturated_signal_pct": 6.3, "target_pct": 60.0}
+    assert "serve pairing inert" in bench.serve_budget_failure(inert, "real_chip")
+    # cpu stand-in: the synthetic peak says nothing about the chip
+    assert bench.serve_budget_failure(inert, "cpu_fallback") is None
+    # reachable: no failure
+    ok = {"target_reachable": True, "saturated_signal_pct": 6.3, "target_pct": 5.0}
+    assert bench.serve_budget_failure(ok, "real_chip") is None
+    # a rung that errored before measuring carries no verdict either way
+    assert bench.serve_budget_failure({"error": "wedged"}, "real_chip") is None
