@@ -1575,6 +1575,33 @@ def run_rung_multihost_quantum() -> dict:
     }
 
 
+def run_rung_chaos() -> dict:
+    """The canned fault storm (chaos/storm.py) as a bench rung: exporter
+    outage, total scrape blackout, node preemption, pod crashloop — one per
+    pipeline layer, each with a measured MTTR.  The acceptance bar is the
+    same as ``simulate chaos``: every fault recovers to the pre-fault
+    replica count and zero scale events fire while the metrics are black."""
+    from k8s_gpu_hpa_tpu.chaos import run_fault_storm
+
+    result = run_fault_storm(pod_start_latency=BASE_POD_START_LATENCY)
+    return {
+        "mode": "virtual",
+        "metric": "fault storm MTTR (s, cleared -> reconverged)",
+        "settled_replicas": result["settled_replicas"],
+        "mttr_s": {
+            f["fault"]: f["mttr"] for f in result["faults"]
+        },
+        "detection_s": {
+            f["fault"]: f["detection_time"] for f in result["faults"]
+        },
+        "all_recovered": result["all_recovered"],
+        "spurious_scale_events_during_blackout": result[
+            "spurious_scale_events_during_blackout"
+        ],
+        "blackout_condition_observed": result["blackout_condition_observed"],
+    }
+
+
 # ---- pod-start sensitivity sweep (VERDICT r3 #5) ---------------------------
 
 
@@ -1971,6 +1998,7 @@ def main() -> None:
             ("0_cpu_resource", run_rung_cpu_resource),
             ("external_queue", run_rung_external_queue),
             ("4_multihost_quantum", run_rung_multihost_quantum),
+            ("chaos_storm", run_rung_chaos),
         ):
             log(f"rung {name}:")
             try:

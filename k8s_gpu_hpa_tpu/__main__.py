@@ -196,7 +196,7 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
     sim.add_argument(
         "--scenario",
-        choices=["spike", "ramp", "flap", "outage", "crash"],
+        choices=["spike", "ramp", "flap", "outage", "crash", "chaos"],
         default="spike",
     )
     sim.add_argument("--duration", type=float, default=420.0)

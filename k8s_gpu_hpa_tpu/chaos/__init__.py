@@ -1,0 +1,21 @@
+"""Declarative, virtual-clock-driven fault injection for the autoscaling
+pipeline: FaultSpecs armed by a ChaosSchedule, recovery accounted per fault
+as a RecoveryReport (detection time, degraded duration, MTTR)."""
+
+from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, FaultSpec
+from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule, RecoveryReport
+from k8s_gpu_hpa_tpu.chaos.storm import (
+    STORM_FAULTS,
+    render_chaos_report,
+    run_fault_storm,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultSpec",
+    "ChaosSchedule",
+    "RecoveryReport",
+    "STORM_FAULTS",
+    "render_chaos_report",
+    "run_fault_storm",
+]

@@ -1,0 +1,223 @@
+"""Declarative fault specs + per-kind injectors for the chaos subsystem.
+
+A :class:`FaultSpec` names *what* breaks, *when*, and *for how long*; the
+injector registry knows *how* to break it against a running
+``AutoscalingPipeline``.  Every pipeline joint (ARCHITECTURE.md layer map)
+has at least one kind:
+
+========================  =====================================================
+kind                      layer it breaks
+========================  =====================================================
+``exporter_outage``       L2→L3: one (or all) exporter scrape targets refuse
+``frozen_samples``        L2: exporter serves 200 but the payload never changes
+``slow_scrape``           L2→L3: fetch exceeds the target's scrape deadline
+``scrape_blackout``       L3: every scrape target down (Prometheus outage)
+``node_preempt``          L0/L1: node reclaimed — pods die, chips gone,
+                          exporter unreachable (spot/preemptible TPU slices)
+``node_drain``            L1: cordon + evict; node and exporter stay up
+``pod_crash``             L1: one pod dies once, replacement pays start latency
+``crashloop``             L1: containers crash on start → CrashLoopBackOff
+``adapter_blackout``      L4: custom-metrics API answers nothing
+========================  =====================================================
+
+Injectors return a ``clear()`` callable that undoes the fault; duration-0
+faults (``pod_crash``) are impulses and clear immediately.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable
+
+from k8s_gpu_hpa_tpu.metrics.tsdb import ScrapeTarget, TimedExposition
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+
+
+@dataclass
+class FaultSpec:
+    """One declared fault: ``kind`` at ``at`` seconds (schedule-relative),
+    lasting ``duration`` seconds (0 = impulse).  ``target`` selects the victim
+    where the kind needs one (a scrape-target name, node name, pod name, or
+    deployment name); None picks the kind's natural default (all exporters,
+    the first node, the pipeline's deployment...)."""
+
+    kind: str
+    at: float
+    duration: float = 0.0
+    target: str | None = None
+    params: dict = field(default_factory=dict)
+    name: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} "
+                f"(have: {', '.join(sorted(FAULT_KINDS))})"
+            )
+        if self.at < 0 or self.duration < 0:
+            raise ValueError("fault at/duration must be >= 0")
+        if not self.name:
+            suffix = f"/{self.target}" if self.target else ""
+            self.name = f"{self.kind}{suffix}@{self.at:g}s"
+
+
+ClearFn = Callable[[], None]
+
+
+def _scrape_targets(
+    pipe: "AutoscalingPipeline", selector: str | None
+) -> list[ScrapeTarget]:
+    if selector is None:
+        return [t for t in pipe.scraper.targets if t.name.startswith("exporter/")]
+    matches = [t for t in pipe.scraper.targets if t.name == selector]
+    if not matches:
+        raise ValueError(f"no scrape target named {selector!r}")
+    return matches
+
+
+def _wrap_fetch(targets: list[ScrapeTarget], make_fetch) -> ClearFn:
+    originals = [(t, t.fetch) for t in targets]
+    for target, original in originals:
+        target.fetch = make_fetch(target, original)
+
+    def clear() -> None:
+        for target, original in originals:
+            target.fetch = original
+
+    return clear
+
+
+def _inject_exporter_outage(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    def make_fetch(target, _original):
+        def refused():
+            raise ConnectionError(f"{target.name}: connection refused (chaos)")
+
+        return refused
+
+    return _wrap_fetch(_scrape_targets(pipe, spec.target), make_fetch)
+
+
+def _inject_frozen_samples(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """The nastiest L2 failure: the endpoint keeps answering 200 with the
+    exposition captured at injection time.  Scrapes 'succeed', ``up`` stays 1,
+    values never move — exactly the freshness bug the exporter's staleness
+    watchdog exists to prevent upstream."""
+
+    def make_fetch(_target, original):
+        frozen = original()
+        if isinstance(frozen, TimedExposition):
+            frozen = frozen.text
+        return lambda: frozen
+
+    return _wrap_fetch(_scrape_targets(pipe, spec.target), make_fetch)
+
+
+def _inject_slow_scrape(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    def make_fetch(target, original):
+        latency = float(spec.params.get("latency", target.deadline * 2.0))
+
+        def slow():
+            fetched = original()
+            text = fetched.text if isinstance(fetched, TimedExposition) else fetched
+            return TimedExposition(text, duration=latency)
+
+        return slow
+
+    return _wrap_fetch(_scrape_targets(pipe, spec.target), make_fetch)
+
+
+def _inject_scrape_blackout(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    def make_fetch(target, _original):
+        def refused():
+            raise ConnectionError(f"{target.name}: scrape blackout (chaos)")
+
+        return refused
+
+    return _wrap_fetch(list(pipe.scraper.targets), make_fetch)
+
+
+def _default_node(pipe: "AutoscalingPipeline", spec: FaultSpec) -> str:
+    if spec.target is not None:
+        if spec.target not in pipe.cluster.nodes:
+            raise ValueError(f"no node named {spec.target!r}")
+        return spec.target
+    return next(iter(pipe.cluster.nodes))
+
+
+def _inject_node_preempt(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    node = _default_node(pipe, spec)
+    pipe.cluster.preempt_node(node)
+    return lambda: pipe.cluster.restore_node(node)
+
+
+def _inject_node_drain(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    node = _default_node(pipe, spec)
+    pipe.cluster.drain_node(node)
+    return lambda: pipe.cluster.restore_node(node)
+
+
+def _inject_pod_crash(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    cluster = pipe.cluster
+    if spec.target is not None:
+        victim = spec.target
+    else:
+        running = cluster.running_pods(pipe.deployment.name)
+        if not running:
+            raise ValueError("pod_crash: no running pod to crash")
+        victim = running[0].name
+    cluster.kill_pod(victim)
+    return lambda: None
+
+
+def _inject_crashloop(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    cluster = pipe.cluster
+    deployment = spec.target or pipe.deployment.name
+    cluster.start_crashloop(deployment)
+    # crash one running pod so the loop is immediately visible (its
+    # replacement enters CrashLoopBackOff); without this the fault only
+    # bites on the next scale-up
+    running = cluster.running_pods(deployment)
+    if running:
+        cluster.kill_pod(running[0].name)
+    return lambda: cluster.stop_crashloop(deployment)
+
+
+class _BlackoutAdapter:
+    """A custom-metrics API that discovers and serves nothing (L4 down)."""
+
+    def get_object_metric(self, *args, **kwargs):
+        return None
+
+    def get_pods_metric(self, *args, **kwargs):
+        return {}
+
+    def get_external_metric(self, *args, **kwargs):
+        return []
+
+    def list_metrics(self):
+        return []
+
+
+def _inject_adapter_blackout(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    real = pipe.hpa.adapter
+    pipe.hpa.adapter = _BlackoutAdapter()
+
+    def clear() -> None:
+        pipe.hpa.adapter = real
+
+    return clear
+
+
+FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = {
+    "exporter_outage": _inject_exporter_outage,
+    "frozen_samples": _inject_frozen_samples,
+    "slow_scrape": _inject_slow_scrape,
+    "scrape_blackout": _inject_scrape_blackout,
+    "node_preempt": _inject_node_preempt,
+    "node_drain": _inject_node_drain,
+    "pod_crash": _inject_pod_crash,
+    "crashloop": _inject_crashloop,
+    "adapter_blackout": _inject_adapter_blackout,
+}

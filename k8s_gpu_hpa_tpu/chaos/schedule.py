@@ -1,0 +1,207 @@
+"""ChaosSchedule: arm declarative faults against a pipeline on virtual time.
+
+The schedule is the experiment harness: it injects each :class:`FaultSpec`
+at its time, clears it after its duration, and runs a 1 Hz monitor that
+turns the pipeline's observable state into a :class:`RecoveryReport` per
+fault — when the degradation became *detectable*, and the MTTR from the
+fault clearing to the pipeline re-converging on the right replica count
+and staying there.
+
+Everything is scheduled through ``clock.call_at``/``call_later`` —
+``VirtualClock.advance`` is not reentrant, so callbacks never advance the
+clock themselves.  Faults are assumed non-overlapping in time (the storm
+in :mod:`.storm` is built that way); the monitor attributes unhealth to the
+earliest unresolved fault.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, ClearFn, FaultSpec
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+
+
+@dataclass
+class RecoveryReport:
+    """Per-fault outcome.  All timestamps are absolute clock seconds.
+
+    - ``detection_time``: injected → first monitor tick that saw unhealth
+      (how long the break stayed invisible).
+    - ``degraded_duration``: detected → recovered.
+    - ``mttr``: cleared → recovered — the pipeline's own recovery work,
+      excluding the fault's dwell time.  A fault nobody noticed (e.g. a
+      tolerated single-exporter blip) recovers with ``detected_at is None``.
+    """
+
+    fault: FaultSpec
+    injected_at: float | None = None
+    cleared_at: float | None = None
+    detected_at: float | None = None
+    recovered_at: float | None = None
+    expected_replicas: int | None = None
+
+    @property
+    def detection_time(self) -> float | None:
+        if self.detected_at is None or self.injected_at is None:
+            return None
+        return self.detected_at - self.injected_at
+
+    @property
+    def degraded_duration(self) -> float | None:
+        if self.recovered_at is None or self.detected_at is None:
+            return None
+        return self.recovered_at - self.detected_at
+
+    @property
+    def mttr(self) -> float | None:
+        if self.recovered_at is None or self.cleared_at is None:
+            return None
+        return max(0.0, self.recovered_at - self.cleared_at)
+
+    @property
+    def recovered(self) -> bool:
+        return self.recovered_at is not None
+
+    def as_dict(self) -> dict:
+        def r(x: float | None) -> float | None:
+            return None if x is None else round(x, 1)
+
+        return {
+            "fault": self.fault.name,
+            "kind": self.fault.kind,
+            "injected_at": r(self.injected_at),
+            "cleared_at": r(self.cleared_at),
+            "detected_at": r(self.detected_at),
+            "recovered_at": r(self.recovered_at),
+            "detection_time": r(self.detection_time),
+            "degraded_duration": r(self.degraded_duration),
+            "mttr": r(self.mttr),
+            "recovered": self.recovered,
+        }
+
+
+@dataclass
+class _Armed:
+    spec: FaultSpec
+    report: RecoveryReport
+    clear_fn: ClearFn | None = None
+    resolved: bool = False
+    #: start of the current consecutive-healthy run after clear, else None
+    healthy_since: float | None = None
+
+
+class ChaosSchedule:
+    """Arm a list of faults against a pipeline and account their recovery.
+
+    ``stable_for``: a fault counts as recovered only once the pipeline has
+    been continuously healthy for this many seconds after the fault cleared
+    (``recovered_at`` backdates to the start of that healthy run)."""
+
+    def __init__(
+        self,
+        pipeline: "AutoscalingPipeline",
+        faults: list[FaultSpec],
+        monitor_interval: float = 1.0,
+        stable_for: float = 10.0,
+    ):
+        self.pipeline = pipeline
+        self.monitor_interval = monitor_interval
+        self.stable_for = stable_for
+        self._armed = [
+            _Armed(spec=s, report=RecoveryReport(fault=s))
+            for s in sorted(faults, key=lambda s: s.at)
+        ]
+        self._armed_at: float | None = None
+
+    @property
+    def reports(self) -> list[RecoveryReport]:
+        return [a.report for a in self._armed]
+
+    def arm(self) -> None:
+        """Schedule all injections/clears and start the monitor.  Call once,
+        then drive the clock (``pipeline.clock.advance(...)``)."""
+        if self._armed_at is not None:
+            raise RuntimeError("ChaosSchedule.arm() called twice")
+        clock = self.pipeline.clock
+        base = self._armed_at = clock.now()
+        for armed in self._armed:
+            clock.call_at(base + armed.spec.at, lambda a=armed: self._inject(a))
+            if armed.spec.duration > 0:
+                clock.call_at(
+                    base + armed.spec.at + armed.spec.duration,
+                    lambda a=armed: self._clear(a),
+                )
+        clock.call_later(self.monitor_interval, self._tick)
+
+    def _inject(self, armed: _Armed) -> None:
+        now = self.pipeline.clock.now()
+        armed.report.injected_at = now
+        # the pre-fault replica count, recorded for the report (callers
+        # assert final convergence against it when load is held constant)
+        armed.report.expected_replicas = self.pipeline.deployment.replicas
+        armed.clear_fn = FAULT_KINDS[armed.spec.kind](self.pipeline, armed.spec)
+        if armed.spec.duration <= 0:  # impulse fault: nothing to undo later
+            self._clear(armed)
+
+    def _clear(self, armed: _Armed) -> None:
+        armed.report.cleared_at = self.pipeline.clock.now()
+        if armed.clear_fn is not None:
+            armed.clear_fn()
+            armed.clear_fn = None
+
+    def _healthy(self) -> bool:
+        # Healthy = converged and observable: every declared replica running,
+        # no pod looping, every node schedulable, every scrape target
+        # answering, and the HPA able to read its metric.  Deliberately NOT
+        # "replicas == pre-fault count": load may legitimately move the goal
+        # while a fault is live (a spike during a blackout); whether the
+        # *final* count is right is the caller's assertion (storm/tests).
+        pipe = self.pipeline
+        dep = pipe.deployment
+        running = len(pipe.cluster.running_pods(dep.name))
+        if running != dep.replicas:
+            return False
+        if any(
+            p.phase == "CrashLoopBackOff"
+            for p in pipe.cluster.pods.values()
+            if p.deployment == dep.name
+        ):
+            return False
+        for node in pipe.cluster.nodes.values():
+            if not (node.ready and node.schedulable):
+                return False
+        for target in pipe.scraper.targets:
+            if not target.healthy:
+                return False
+        active = pipe.hpa.status.condition("ScalingActive")
+        if active is not None and not active.status:
+            return False
+        return True
+
+    def _tick(self) -> None:
+        now = self.pipeline.clock.now()
+        current = next((a for a in self._armed if not a.resolved), None)
+        if current is None:
+            return  # all faults accounted; stop the tick chain
+        report = current.report
+        if report.injected_at is not None:
+            healthy = self._healthy()
+            if not healthy and report.detected_at is None:
+                report.detected_at = now
+            if report.cleared_at is not None:
+                if healthy:
+                    if current.healthy_since is None:
+                        current.healthy_since = now
+                    if now - current.healthy_since >= self.stable_for:
+                        report.recovered_at = current.healthy_since
+                        current.resolved = True
+                else:
+                    current.healthy_since = None
+        self.pipeline.clock.call_later(self.monitor_interval, self._tick)
+
+    def all_recovered(self) -> bool:
+        return all(a.report.recovered for a in self._armed)

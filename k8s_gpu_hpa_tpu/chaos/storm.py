@@ -1,0 +1,135 @@
+"""The canned fault storm: one run, every layer broken once, MTTR per fault.
+
+This is the ``chaos`` rung behind ``python -m k8s_gpu_hpa_tpu.simulate chaos``
+and bench.py's ``chaos_storm`` phase.  It is deliberately manifest-independent
+(a fixed 3-node/2-chip cluster under steady shared load) so the numbers are
+comparable run-to-run: the thing under test is the *pipeline's* recovery
+machinery, not a particular deployment.
+
+Storm timeline (steady load 90 % shared, target 40 ⇒ settles at 3 replicas):
+
+=========  ==============================  =======================================
+t (s)      fault                           what must happen
+=========  ==============================  =======================================
+30–90      exporter_outage (one node)      signal degrades, never zeroes; up=0
+                                           for that target; replicas hold
+180–270    scrape_blackout (all targets)   HPA holds (ScalingActive=False,
+                                           FailedGetObjectMetric); ZERO scale
+                                           events while blind
+420–540    node_preempt (chaos-node-0)     pods die with their chips; survivors
+                                           reschedule; exporter unreachable;
+                                           full re-convergence after restore
+660–720    crashloop (tpu-test)            replacement pods CrashLoopBackOff
+                                           with doubling restart delays; loop
+                                           re-converges once the image is fixed
+=========  ==============================  =======================================
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_hpa_tpu.chaos.faults import FaultSpec
+from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import HPABehavior
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+STORM_FAULTS = [
+    FaultSpec("exporter_outage", at=30.0, duration=60.0, target="exporter/chaos-node-1"),
+    FaultSpec("scrape_blackout", at=180.0, duration=90.0),
+    FaultSpec("node_preempt", at=420.0, duration=120.0, target="chaos-node-0"),
+    FaultSpec("crashloop", at=660.0, duration=60.0, target="tpu-test"),
+]
+
+
+def run_fault_storm(
+    pod_start_latency: float = 12.0,
+    total: float = 1000.0,
+) -> dict:
+    """Run the canned storm; returns a JSON-able result dict."""
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"chaos-node-{i}", 2) for i in range(3)],
+        pod_start_latency=pod_start_latency,
+    )
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", load_fn=lambda t: 90.0, load_mode="shared"
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+
+    # Scale-down stabilization pinned to 60 s (from the k8s default 300 s) so
+    # post-fault re-convergence fits the storm window and MTTR is measurable.
+    behavior = HPABehavior()
+    behavior.scale_down.stabilization_window_seconds = 60.0
+
+    pipe = AutoscalingPipeline(
+        cluster, dep, target_value=40.0, max_replicas=4, behavior=behavior
+    )
+    pipe.start()
+    clock.advance(120.0)  # settle: shared 90 % over target 40 ⇒ 3 replicas
+    settled = pipe.replicas()
+
+    schedule = ChaosSchedule(pipe, STORM_FAULTS)
+    schedule.arm()
+    clock.advance(total)
+
+    reports = schedule.reports
+    blackout = next(r for r in reports if r.fault.kind == "scrape_blackout")
+    spurious = [
+        ev
+        for ev in pipe.scale_history
+        if blackout.injected_at is not None
+        and blackout.cleared_at is not None
+        and blackout.injected_at <= ev[0] < blackout.cleared_at
+    ]
+    blackout_condition_observed = any(
+        type_ == "ScalingActive"
+        and status is False
+        and reason == "FailedGetObjectMetric"
+        and blackout.injected_at is not None
+        and blackout.cleared_at is not None
+        and blackout.injected_at <= ts < blackout.cleared_at
+        for ts, type_, status, reason in pipe.hpa.condition_history
+    )
+
+    return {
+        "scenario": "chaos",
+        "mode": "virtual",
+        "settled_replicas": settled,
+        "faults": [r.as_dict() for r in reports],
+        "all_recovered": schedule.all_recovered(),
+        "spurious_scale_events_during_blackout": len(spurious),
+        "blackout_condition_observed": blackout_condition_observed,
+        "final_replicas": pipe.replicas(),
+        "final_running": pipe.running(),
+        "scale_events": len(pipe.scale_history),
+    }
+
+
+def render_chaos_report(result: dict) -> str:
+    lines = [
+        "chaos storm: 4 faults over "
+        f"{len(result['faults'])} layers, settled at "
+        f"{result['settled_replicas']} replicas",
+        "",
+        f"{'fault':<34} {'detect':>7} {'mttr':>7}  recovered",
+    ]
+    for f in result["faults"]:
+        detect = "-" if f["detection_time"] is None else f"{f['detection_time']:.0f}s"
+        mttr = "-" if f["mttr"] is None else f"{f['mttr']:.0f}s"
+        lines.append(
+            f"{f['fault']:<34} {detect:>7} {mttr:>7}  "
+            f"{'yes' if f['recovered'] else 'NO'}"
+        )
+    lines += [
+        "",
+        f"all recovered:            {result['all_recovered']}",
+        "spurious scale events during blackout: "
+        f"{result['spurious_scale_events_during_blackout']}",
+        "ScalingActive=False (FailedGetObjectMetric) observed during blackout: "
+        f"{result['blackout_condition_observed']}",
+        f"final replicas/running:   {result['final_replicas']}/{result['final_running']}",
+    ]
+    return "\n".join(lines)

@@ -40,11 +40,14 @@ class SimPod:
     labels: dict[str, str]
     deployment: str
     chips_requested: int
-    phase: str = "Pending"  # Pending -> Running -> (deleted)
+    phase: str = "Pending"  # Pending -> Running -> (deleted); CrashLoopBackOff
     node: str | None = None
     chip_ids: list[int] = field(default_factory=list)
     created_at: float = 0.0
     started_at: float | None = None
+    #: container restarts while crashlooping (drives the kubelet's
+    #: exponential restart backoff)
+    restart_count: int = 0
 
 
 @dataclass
@@ -53,6 +56,10 @@ class SimNode:
     num_chips: int
     #: chip index -> pod name
     allocations: dict[int, str] = field(default_factory=dict)
+    #: False after preemption: node is gone — exporter unreachable, chips lost
+    ready: bool = True
+    #: False while cordoned (drain) or preempted: scheduler skips the node
+    schedulable: bool = True
 
     def free_chips(self) -> list[int]:
         return [i for i in range(self.num_chips) if i not in self.allocations]
@@ -210,6 +217,9 @@ class SimCluster:
         self.pods: dict[str, SimPod] = {}
         self.deployments: dict[str, SimDeployment] = {}
         self.pod_start_latency = pod_start_latency
+        #: deployments whose containers currently crash on start (chaos):
+        #: their pods cycle through CrashLoopBackOff instead of Running
+        self.crashlooping: set[str] = set()
         self._name_counter = itertools.count()
         self.exporters = {
             name: _NodeExporter(self, node, exporter_sample_interval)
@@ -251,7 +261,18 @@ class SimCluster:
     def _try_start(self, pod: SimPod) -> None:
         if pod.name not in self.pods or pod.phase == "Running":
             return
+        if pod.deployment in self.crashlooping:
+            # Container starts, crashes immediately: CrashLoopBackOff with the
+            # kubelet's exponential restart delay (10 s base, doubling, 5 min
+            # cap).  No chips are held while backing off.
+            pod.restart_count += 1
+            pod.phase = "CrashLoopBackOff"
+            delay = min(300.0, 10.0 * 2.0 ** (pod.restart_count - 1))
+            self.clock.call_later(delay, lambda: self._try_start(pod))
+            return
         for node in self.nodes.values():
+            if not (node.ready and node.schedulable):
+                continue
             free = node.free_chips()
             if len(free) >= pod.chips_requested:
                 pod.node = node.name
@@ -262,6 +283,7 @@ class SimCluster:
                 pod.started_at = self.clock.now()
                 return
         # No capacity: stay Pending, retry (kube-scheduler requeue).
+        pod.phase = "Pending"
         self.clock.call_later(5.0, lambda: self._try_start(pod))
 
     def _delete_pod(self, pod: SimPod) -> None:
@@ -284,9 +306,70 @@ class SimCluster:
         self._delete_pod(pod)
         self.reconcile(deployment)
 
+    # ---- node lifecycle (spot/preemptible TPU slices) ----------------------
+
+    def preempt_node(self, name: str) -> None:
+        """GKE spot/preemptible reclamation: the node vanishes NOW.  Resident
+        pods die, their chips are reclaimed with the node, the per-node
+        exporter becomes unreachable (scrapes fail, not stale-freeze), and the
+        ReplicaSet controller immediately creates replacements that must
+        schedule on the surviving nodes — or sit Pending until capacity
+        returns (``restore_node``)."""
+        node = self.nodes[name]
+        node.ready = False
+        node.schedulable = False
+        victims = [p for p in self.pods.values() if p.node == name]
+        affected: dict[str, SimDeployment] = {}
+        for pod in victims:
+            affected[pod.deployment] = self.deployments[pod.deployment]
+            self._delete_pod(pod)
+        node.allocations.clear()
+        for deployment in affected.values():
+            self.reconcile(deployment)
+
+    def drain_node(self, name: str) -> None:
+        """``kubectl drain``: cordon (no new pods) then evict resident pods,
+        which reschedule elsewhere.  Unlike preemption the node stays up — its
+        exporter keeps serving (idle chips), so the signal degrades gracefully
+        instead of a scrape failing."""
+        node = self.nodes[name]
+        node.schedulable = False
+        victims = [p for p in self.pods.values() if p.node == name]
+        affected: dict[str, SimDeployment] = {}
+        for pod in victims:
+            affected[pod.deployment] = self.deployments[pod.deployment]
+            self._delete_pod(pod)
+        for deployment in affected.values():
+            self.reconcile(deployment)
+
+    def restore_node(self, name: str) -> None:
+        """Bring a preempted/drained node back: schedulable with all chips
+        free.  Pending pods pick it up on their next requeue."""
+        node = self.nodes[name]
+        node.ready = True
+        node.schedulable = True
+        node.allocations.clear()
+
+    # ---- crashloop injection (chaos) ---------------------------------------
+
+    def start_crashloop(self, deployment_name: str) -> None:
+        """Make the deployment's containers crash on start: every pod that
+        would start instead enters CrashLoopBackOff (exponential restart
+        delays).  Pods already Running keep running — crash them explicitly
+        with ``kill_pod`` to put their replacements into the loop."""
+        if deployment_name not in self.deployments:
+            raise KeyError(f"no deployment {deployment_name}")
+        self.crashlooping.add(deployment_name)
+
+    def stop_crashloop(self, deployment_name: str) -> None:
+        """Clear the crash fault; backing-off pods start on their next retry."""
+        self.crashlooping.discard(deployment_name)
+
     # ---- metric endpoints --------------------------------------------------
 
     def exporter_fetch(self, node_name: str) -> str:
+        if not self.nodes[node_name].ready:
+            raise ConnectionError(f"node {node_name} is down (preempted)")
         return self.exporters[node_name].fetch()
 
     def kube_state_metrics_text(self) -> str:

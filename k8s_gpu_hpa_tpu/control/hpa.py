@@ -168,6 +168,29 @@ class ScalableTarget(Protocol):
 
 
 @dataclass
+class HPACondition:
+    """One Kubernetes-style status condition (``status.conditions[]`` of a
+    real autoscaling/v2 object): machine-readable *why*, so a holding HPA is
+    observable instead of silent — the exact field the doctor's L5 probe
+    reads off a live cluster (doctor.check_hpa_status)."""
+
+    type: str  # "AbleToScale" | "ScalingActive"
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float | None = None
+
+    def as_k8s(self) -> dict:
+        """The shape ``kubectl get --raw .../horizontalpodautoscalers`` serves."""
+        return {
+            "type": self.type,
+            "status": "True" if self.status else "False",
+            "reason": self.reason,
+            "message": self.message,
+        }
+
+
+@dataclass
 class HPAStatus:
     current_replicas: int = 1
     desired_replicas: int = 1
@@ -175,6 +198,14 @@ class HPAStatus:
     last_scale_time: float | None = None
     #: why the last sync made its decision, for observability/tests
     last_reason: str = ""
+    #: condition type -> current condition (AbleToScale / ScalingActive)
+    conditions: dict[str, HPACondition] = field(default_factory=dict)
+
+    def condition(self, type_: str) -> HPACondition | None:
+        return self.conditions.get(type_)
+
+    def conditions_as_k8s(self) -> list[dict]:
+        return [c.as_k8s() for c in self.conditions.values()]
 
 
 def behavior_from_manifest(hpa_doc: dict) -> HPABehavior:
@@ -337,10 +368,45 @@ class HPAController:
         self.pod_lister = pod_lister
         self.namespace = namespace
         self.status = HPAStatus(current_replicas=target.replicas)
+        #: (ts, type, status, reason) log of every condition status/reason
+        #: change, for tests and the chaos monitor (real HPAs only keep the
+        #: latest condition; the history is sim-only observability)
+        self.condition_history: list[tuple[float, str, bool, str]] = []
         #: (ts, recommendation) ring for stabilization windows
         self._recommendations: list[tuple[float, int]] = []
         #: (ts, replicas_after) scale-event log for policy period lookback
         self._scale_events: list[tuple[float, int]] = [(clock.now(), target.replicas)]
+
+    # ---- status conditions -------------------------------------------------
+
+    def _set_condition(
+        self, type_: str, status: bool, reason: str, message: str = ""
+    ) -> None:
+        now = self.clock.now()
+        prev = self.status.conditions.get(type_)
+        transition = (
+            now
+            if prev is None or prev.status != status
+            else prev.last_transition_time
+        )
+        if prev is None or prev.status != status or prev.reason != reason:
+            self.condition_history.append((now, type_, status, reason))
+        self.status.conditions[type_] = HPACondition(
+            type_, status, reason, message, transition
+        )
+
+    def _unavailable_reason(self) -> str:
+        """The k8s reason string for "could not fetch the metric", keyed off
+        the first metric spec's type (FailedGet{Object,Pods,Resource,External}
+        Metric — what kube-controller-manager sets on ScalingActive)."""
+        spec = self.metrics[0] if self.metrics else None
+        if isinstance(spec, PodsMetricSpec):
+            return "FailedGetPodsMetric"
+        if isinstance(spec, ResourceMetricSpec):
+            return "FailedGetResourceMetric"
+        if isinstance(spec, ExternalMetricSpec):
+            return "FailedGetExternalMetric"
+        return "FailedGetObjectMetric"
 
     # ---- core v2 algorithm -------------------------------------------------
 
@@ -457,6 +523,12 @@ class HPAController:
     def sync_once(self) -> HPAStatus:
         current = self.target.replicas
         self.status.current_replicas = current
+        self._set_condition(
+            "AbleToScale",
+            True,
+            "SucceededGetScale",
+            "the HPA controller was able to get the target's current scale",
+        )
 
         proposals = [self._metric_proposal(spec, current) for spec in self.metrics]
         valid = [p for p in proposals if p is not None]
@@ -464,7 +536,20 @@ class HPAController:
             # All metrics unavailable: hold (K8s skips scaling on total failure).
             self.status.last_reason = "metrics unavailable; holding"
             self.status.desired_replicas = current
+            self._set_condition(
+                "ScalingActive",
+                False,
+                self._unavailable_reason(),
+                "the HPA was unable to compute the replica count: "
+                "no metric values available",
+            )
             return self.status
+        self._set_condition(
+            "ScalingActive",
+            True,
+            "ValidMetricFound",
+            "the HPA was able to successfully calculate a replica count",
+        )
 
         recommendation = max(valid)  # multiple metrics -> largest proposal
         recommendation = min(max(recommendation, self.min_replicas), self.max_replicas)
@@ -513,6 +598,13 @@ class HPAController:
             self._scale_events.append((now, desired))
             self._prune_scale_events(now)
             self.status.last_scale_time = now
+            self._set_condition(
+                "AbleToScale",
+                True,
+                "SucceededRescale",
+                f"the HPA controller was able to update the target scale "
+                f"to {desired}",
+            )
             if self.on_scale:
                 self.on_scale(current, desired)
         return self.status

@@ -153,6 +153,12 @@ class AutoscalingPipeline:
         self._clock = clock
         self._started = False
 
+    @property
+    def clock(self) -> VirtualClock:
+        """The virtual clock everything is scheduled on (shared with the
+        cluster); exposed for harnesses like the chaos schedule."""
+        return self._clock
+
     def start(self) -> None:
         """Register the periodic loops on the virtual clock."""
         if self._started:

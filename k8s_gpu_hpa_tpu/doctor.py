@@ -116,6 +116,39 @@ def check_prom_vector(payload: str, metric: str) -> str:
     return f"{metric}={value} {addressed}"
 
 
+def check_scrape_up(payload: str) -> str:
+    """L3 scrape health: every scrape target is actually answering
+    (``up == 1``).  Prometheus synthesizes ``up`` per target, and the sim
+    scraper does the same (metrics/tsdb.py) — a target that is down degrades
+    coverage silently from the recorded-series probe's point of view (the
+    average keeps being served from survivors), so the runbook checks ``up``
+    explicitly.  ``payload`` is the instant-query JSON for ``up``."""
+    doc = json.loads(payload)
+    if doc.get("status") != "success":
+        raise AssertionError(f"prometheus query failed: {doc}")
+    results = doc["data"]["result"]
+    if not results:
+        raise AssertionError(
+            "no up series at all: the scrape config matched zero targets"
+        )
+    down = []
+    for r in results:
+        if float(r["value"][1]) != 1.0:
+            labels = r["metric"]
+            down.append(
+                labels.get("target")
+                or labels.get("instance")
+                or labels.get("job")
+                or "?"
+            )
+    if down:
+        raise AssertionError(
+            f"{len(down)}/{len(results)} scrape target(s) down: "
+            + ", ".join(sorted(down))
+        )
+    return f"all {len(results)} scrape targets up"
+
+
 def check_custom_metrics_api(payload: str, metric: str) -> str:
     """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
     doc = json.loads(payload)
@@ -205,6 +238,7 @@ def diagnose(
     metric: str = "tpu_test_tensorcore_avg",
     alerts_fetch: Callable[[], str] | None = None,
     operator_fetch: Callable[[], str] | None = None,
+    up_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -221,6 +255,11 @@ def diagnose(
             "L3 prometheus",
             f"recorded series {metric} exists and is object-addressed",
             (lambda: check_prom_vector(prom_fetch(), metric)) if prom_fetch else None,
+        ),
+        (
+            "L3 scrape health",
+            "every scrape target serving (up==1)",
+            (lambda: check_scrape_up(up_fetch())) if up_fetch else None,
         ),
         (
             "L4 custom-metrics API",
@@ -425,6 +464,7 @@ def main() -> int:
         ),
         metric=metric,
         alerts_fetch=lambda: _http_fetch(f"{prom_url}/api/v1/alerts"),
+        up_fetch=lambda: _http_fetch(f"{prom_url}/api/v1/query?query=up"),
         # optional: only deployed alongside multihost rungs — set e.g.
         # OPERATOR_URL=http://localhost:8086/metrics after
         # `kubectl port-forward deploy/quantum-operator 8086`

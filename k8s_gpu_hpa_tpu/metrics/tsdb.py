@@ -18,6 +18,7 @@ pipeline depends on:
 from __future__ import annotations
 
 import math
+import random
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -97,6 +98,21 @@ class TimeSeriesDB:
         return sorted(self._data)
 
 
+class ScrapeTimeout(Exception):
+    """A fetch whose (simulated) duration exceeded the target's deadline."""
+
+
+@dataclass
+class TimedExposition:
+    """Exposition text plus how long serving it took.  A fetch callable may
+    return this instead of a plain string so virtual-time harnesses can model
+    slow endpoints; the scraper enforces the per-target deadline against
+    ``duration`` (in production the HTTP client's timeout does this)."""
+
+    text: str
+    duration: float = 0.0
+
+
 @dataclass
 class ScrapeTarget:
     """One endpoint: ``fetch`` returns exposition text (HTTP GET in production).
@@ -106,20 +122,51 @@ class ScrapeTarget:
     Kubernetes node name onto each sample (kube-prometheus-stack-values.yaml:13-16).
     """
 
-    fetch: Callable[[], str]
+    fetch: Callable[[], "str | TimedExposition"]
     attached_labels: dict[str, str] = field(default_factory=dict)
     name: str = ""
     healthy: bool = True
     #: series produced by the last successful scrape, for staleness marking
     last_series: set[tuple[str, LabelSet]] = field(default_factory=set)
+    #: per-target scrape deadline (Prometheus ``scrape_timeout``): a fetch
+    #: reporting a longer duration counts as a failed scrape
+    deadline: float = 10.0
+    #: failure streak driving the exponential backoff
+    consecutive_failures: int = 0
+    #: do not re-attempt before this timestamp (backoff gate)
+    next_attempt_at: float = -math.inf
+    #: total fetch attempts, for observability/tests
+    attempts: int = 0
 
 
 class Scraper:
-    """Pulls all targets into the TSDB; drive via ``scrape_once`` on a schedule."""
+    """Pulls all targets into the TSDB; drive via ``scrape_once`` on a schedule.
 
-    def __init__(self, db: TimeSeriesDB, interval: float = 1.0):
+    Failure handling (the chaos-hardening contract):
+
+    - a failing or deadline-busting target gets staleness markers and an
+      ``up{target=...} 0`` sample — the degradation is *observable*, never a
+      frozen value;
+    - consecutive failures back the target off exponentially (base doubles up
+      to ``backoff_cap``) with deterministic jitter, so a dead endpoint is not
+      hammered every interval and recovery probes stay bounded by the cap.
+    """
+
+    def __init__(
+        self,
+        db: TimeSeriesDB,
+        interval: float = 1.0,
+        backoff_base: float = 1.0,
+        backoff_cap: float = 30.0,
+        backoff_jitter: float = 0.1,
+    ):
         self.db = db
         self.interval = interval
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.backoff_jitter = backoff_jitter
+        #: seeded so virtual-time runs are reproducible event-for-event
+        self._rng = random.Random(0)
         self.targets: list[ScrapeTarget] = []
 
     def add_target(
@@ -132,24 +179,60 @@ class Scraper:
     def remove_target(self, target: ScrapeTarget) -> None:
         self.targets.remove(target)
 
+    def _up_labels(self, target: ScrapeTarget) -> LabelSet:
+        labels = dict(target.attached_labels)
+        labels["target"] = target.name or "?"
+        return tuple(sorted(labels.items()))
+
+    def _record_up(self, target: ScrapeTarget, value: float, ts: float) -> None:
+        self.db.append("up", self._up_labels(target), value, ts)
+
+    def _backoff(self, target: ScrapeTarget, now: float) -> None:
+        # exp=10 already exceeds any sane cap; bounding it keeps the streak
+        # counter free to grow without overflowing the power
+        exponent = min(target.consecutive_failures - 1, 10)
+        delay = min(self.backoff_cap, self.backoff_base * 2.0**exponent)
+        target.next_attempt_at = now + delay * (
+            1.0 + self.backoff_jitter * self._rng.random()
+        )
+
     def scrape_once(self) -> int:
-        """Scrape every target.  A failing target gets staleness markers on all
-        series it produced last time (Prometheus semantics: a down target's
+        """Scrape every due target.  A failing target gets staleness markers on
+        all series it produced last time (Prometheus semantics: a down target's
         series go stale at the next scrape, they don't linger for the lookback
-        window).  Returns number of samples ingested."""
+        window), an ``up`` sample of 0, and an exponential backoff before the
+        next attempt.  Returns number of samples ingested."""
         count = 0
         for target in self.targets:
             ts = self.db.clock.now()
+            if ts < target.next_attempt_at:
+                continue  # backing off after consecutive failures
+            target.attempts += 1
             try:
-                text = target.fetch()
+                fetched = target.fetch()
+                if isinstance(fetched, TimedExposition):
+                    if fetched.duration > target.deadline:
+                        raise ScrapeTimeout(
+                            f"{target.name or '?'}: scrape took "
+                            f"{fetched.duration:.1f}s > deadline "
+                            f"{target.deadline:.1f}s"
+                        )
+                    text = fetched.text
+                else:
+                    text = fetched
             except Exception:
                 if target.healthy:
                     for name, labels in target.last_series:
                         self.db.mark_stale(name, labels, ts)
                 target.healthy = False
                 target.last_series = set()
+                target.consecutive_failures += 1
+                self._backoff(target, ts)
+                self._record_up(target, 0.0, ts)
                 continue
             target.healthy = True
+            target.consecutive_failures = 0
+            target.next_attempt_at = -math.inf
             produced: set[tuple[str, LabelSet]] = set()
             for fam in parse_text(text):
                 for sample in fam.samples:
@@ -163,4 +246,5 @@ class Scraper:
             for name, labels in target.last_series - produced:
                 self.db.mark_stale(name, labels, ts)
             target.last_series = produced
+            self._record_up(target, 1.0, ts)
         return count

@@ -16,7 +16,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from k8s_gpu_hpa_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_gpu_hpa_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS

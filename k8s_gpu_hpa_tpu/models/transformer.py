@@ -21,7 +21,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from k8s_gpu_hpa_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_gpu_hpa_tpu.ops.ring_attention import ring_attention_local

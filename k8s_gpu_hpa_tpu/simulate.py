@@ -18,6 +18,12 @@ Scenarios (offered load in percent-of-one-chip units; replicas share it):
                  shows the hold-don't-act failure semantics.
 - ``crash``    — steady high load, one pod crashes at t=120: shows the
                  replacement paying start latency and the loop re-stabilizing.
+- ``chaos``    — the canned fault storm (chaos/storm.py): exporter outage,
+                 total scrape blackout, node preemption, pod crashloop — one
+                 per pipeline layer, each with a measured MTTR.  Runs on a
+                 fixed cluster (manifest-independent) so numbers compare
+                 run-to-run; exits non-zero if any fault fails to recover or
+                 a scale event fires during the metric blackout.
 
 External-metric HPAs (the queue rung, deploy/tpu-test-external-hpa.yaml)
 are detected from the manifest and play the same scenario names in
@@ -288,6 +294,20 @@ def main(args) -> int:
 
     from k8s_gpu_hpa_tpu.control.hpa import ExternalMetricSpec
 
+    if args.scenario == "chaos":
+        # the storm is manifest-independent by design (see chaos/storm.py):
+        # it measures the pipeline's recovery machinery on a fixed cluster,
+        # so any --hpa flag is ignored rather than reinterpreted
+        from k8s_gpu_hpa_tpu.chaos import render_chaos_report, run_fault_storm
+
+        result = run_fault_storm(pod_start_latency=args.pod_start)
+        print(render_chaos_report(result))
+        ok = (
+            result["all_recovered"]
+            and result["spurious_scale_events_during_blackout"] == 0
+        )
+        return 0 if ok else 2
+
     hpa_doc = yaml.safe_load(Path(args.hpa).read_text())
     metrics = metrics_from_manifest(hpa_doc)
     try:
@@ -321,3 +341,27 @@ def main(args) -> int:
         return 2
     print(render_report(report))
     return 0
+
+
+if __name__ == "__main__":
+    # direct form: ``python -m k8s_gpu_hpa_tpu.simulate chaos`` — the scenario
+    # as a bare positional, mirroring the umbrella CLI's flags otherwise
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m k8s_gpu_hpa_tpu.simulate",
+        description="play a load scenario against a shipped HPA manifest "
+        "(virtual time); 'chaos' runs the canned fault storm",
+    )
+    parser.add_argument(
+        "scenario",
+        nargs="?",
+        default="spike",
+        choices=["spike", "ramp", "flap", "outage", "crash", "chaos"],
+    )
+    parser.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
+    parser.add_argument("--duration", type=float, default=420.0)
+    parser.add_argument("--pod-start", type=float, default=12.0)
+    parser.add_argument("--saturated-pct", type=float, default=None)
+    sys.exit(main(parser.parse_args()))

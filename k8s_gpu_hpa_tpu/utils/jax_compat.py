@@ -1,0 +1,32 @@
+"""jax API compatibility shims.
+
+``shard_map`` has moved twice in jax's history: born in
+``jax.experimental.shard_map``, then promoted to the top-level ``jax``
+namespace (with the experimental path deprecated and later removed), and
+the replication-check kwarg was renamed ``check_rep`` -> ``check_vma``
+along the way.  The container images this repo runs on span both eras, so
+every module imports it from here instead of guessing which jax it got,
+and uses the NEW spelling (``check_vma=``); on older jax the wrapper
+translates.
+
+All call sites pass ``mesh``/``in_specs``/``out_specs`` as keywords, which
+both signatures accept.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # newer jax: top-level API, check_vma kwarg
+    from jax import shard_map
+except ImportError:  # older jax: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -23,3 +23,26 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import subprocess  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def build_native_or_skip():
+    """Build the C++ exporter core, or skip the test on hosts without the
+    cmake/ninja toolchain — a missing optional native build is an environment
+    fact, never a test error."""
+    from k8s_gpu_hpa_tpu.exporter.native import build_native
+
+    try:
+        return build_native()
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("cpp exporter not built")
+
+
+@pytest.fixture(scope="session")
+def native_built():
+    """Shared fixture form of ``build_native_or_skip`` for whole-module
+    native-exporter suites."""
+    return build_native_or_skip()

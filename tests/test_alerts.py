@@ -89,7 +89,9 @@ def test_exporter_outage_fires_and_clears_in_live_loop():
     assert "TpuAutoscaleSignalAbsent" in pipe.evaluator.firing_alerts()
 
     target.fetch = original
-    clock.advance(10.0)
+    # recovery is bounded by the scraper's backoff cap (30 s + jitter): the
+    # next probe of a long-dead target can be up to ~33 s out
+    clock.advance(40.0)
     assert pipe.evaluator.firing_alerts() == []
 
 

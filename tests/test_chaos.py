@@ -1,0 +1,315 @@
+"""Chaos subsystem + pipeline-hardening contracts.
+
+Covers the pieces PR hardening added on top of the failure-injection
+scenarios (tests/test_failure_injection.py):
+
+- Scraper: ``up{target=...}`` series, exponential backoff thinning the
+  attempts against a dead target, per-target scrape deadlines;
+- HPAController: k8s-style status conditions and their transition history
+  (ScalingActive flipping to FailedGetObjectMetric and back);
+- SimCluster: node preempt/drain/restore lifecycle, CrashLoopBackOff
+  restart-delay doubling;
+- the canned fault storm end-to-end (bounded MTTR, zero spurious scale
+  events while blind).
+"""
+
+import math
+
+import pytest
+
+from k8s_gpu_hpa_tpu.chaos import ChaosSchedule, FaultSpec, run_fault_storm
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimedExposition, TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+EXPO = '# TYPE tpu_duty_cycle gauge\ntpu_duty_cycle{chip="0"} 55.0\n'
+
+
+def make_scraper():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    return clock, db, Scraper(db)
+
+
+def make_pipeline(load_fn, *, nodes=2, chips=4):
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"tpu-node-{i}", chips) for i in range(nodes)],
+        pod_start_latency=12.0,
+    )
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", load_fn=load_fn, load_mode="shared"
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(cluster, dep, target_value=40.0, max_replicas=4)
+    pipe.start()
+    return clock, cluster, dep, pipe
+
+
+# ---- scraper hardening ------------------------------------------------------
+
+
+def test_up_series_tracks_target_health():
+    clock, db, scraper = make_scraper()
+    state = {"fail": False}
+
+    def fetch():
+        if state["fail"]:
+            raise ConnectionError("down")
+        return EXPO
+
+    scraper.add_target(fetch, name="exporter/n0", node="n0")
+    scraper.scrape_once()
+    assert db.latest("up", {"target": "exporter/n0"}) == 1.0
+    # the node label rides along, same as on every scraped sample
+    assert db.latest("up", {"node": "n0"}) == 1.0
+
+    state["fail"] = True
+    clock.advance(1.0)
+    scraper.scrape_once()
+    assert db.latest("up", {"target": "exporter/n0"}) == 0.0
+
+    state["fail"] = False
+    clock.advance(60.0)  # past any backoff gate
+    scraper.scrape_once()
+    assert db.latest("up", {"target": "exporter/n0"}) == 1.0
+
+
+def test_backoff_thins_attempts_against_dead_target():
+    """A dead endpoint scraped at 1 Hz for a minute must see far fewer than
+    60 connection attempts (1,2,4,...-second gaps up to the 30 s cap), and
+    the backoff must reset to nothing after one success."""
+    clock, db, scraper = make_scraper()
+    state = {"fail": True}
+
+    def fetch():
+        if state["fail"]:
+            raise ConnectionError("down")
+        return EXPO
+
+    target = scraper.add_target(fetch, name="exporter/n0")
+    for _ in range(60):
+        scraper.scrape_once()
+        clock.advance(1.0)
+    assert target.attempts < 15, f"backoff not thinning: {target.attempts} attempts"
+    assert target.consecutive_failures == target.attempts
+
+    state["fail"] = False
+    # next allowed attempt is at most cap * (1 + jitter) away
+    clock.advance(scraper.backoff_cap * 1.2)
+    scraper.scrape_once()
+    assert target.healthy
+    assert target.consecutive_failures == 0
+    assert target.next_attempt_at == -math.inf
+    # healthy target scrapes every interval again, no gate
+    before = target.attempts
+    for _ in range(5):
+        clock.advance(1.0)
+        scraper.scrape_once()
+    assert target.attempts == before + 5
+
+
+def test_slow_scrape_busts_deadline_and_counts_as_failure():
+    clock, db, scraper = make_scraper()
+    state = {"latency": 20.0}
+
+    def fetch():
+        return TimedExposition(EXPO, duration=state["latency"])
+
+    target = scraper.add_target(fetch, name="exporter/n0")
+    assert target.deadline == 10.0  # prometheus-style default
+    scraper.scrape_once()
+    assert not target.healthy
+    assert db.latest("up", {"target": "exporter/n0"}) == 0.0
+    assert db.latest("tpu_duty_cycle", {"chip": "0"}) is None
+
+    state["latency"] = 0.5  # fast again
+    clock.advance(60.0)
+    scraper.scrape_once()
+    assert target.healthy
+    assert db.latest("tpu_duty_cycle", {"chip": "0"}) == 55.0
+
+
+def test_deadline_failure_marks_previous_series_stale():
+    clock, db, scraper = make_scraper()
+    state = {"latency": 0.0}
+    scraper.add_target(
+        lambda: TimedExposition(EXPO, duration=state["latency"]), name="e"
+    )
+    scraper.scrape_once()
+    assert db.latest("tpu_duty_cycle", {"chip": "0"}) == 55.0
+    state["latency"] = 99.0
+    clock.advance(1.0)
+    scraper.scrape_once()
+    assert db.latest("tpu_duty_cycle", {"chip": "0"}) is None, (
+        "series from the last good scrape must go stale, not linger"
+    )
+
+
+# ---- HPA status conditions --------------------------------------------------
+
+
+def test_conditions_transition_active_failed_active():
+    """ScalingActive must flip False/FailedGetObjectMetric while the metric
+    is black and back to True/ValidMetricFound after recovery — with the
+    transitions recorded in order in condition_history."""
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 35.0, nodes=1)
+    clock.advance(60.0)
+    active = pipe.hpa.status.condition("ScalingActive")
+    assert active is not None and active.status is True
+    assert active.reason == "ValidMetricFound"
+    able = pipe.hpa.status.condition("AbleToScale")
+    assert able is not None and able.status is True
+
+    schedule = ChaosSchedule(
+        pipe, [FaultSpec("exporter_outage", at=0.0, duration=90.0)]
+    )
+    schedule.arm()
+    clock.advance(80.0)
+    active = pipe.hpa.status.condition("ScalingActive")
+    assert active.status is False
+    assert active.reason == "FailedGetObjectMetric"
+    assert active.as_k8s()["status"] == "False"
+
+    clock.advance(120.0)
+    active = pipe.hpa.status.condition("ScalingActive")
+    assert active.status is True and active.reason == "ValidMetricFound"
+
+    reasons = [
+        (status, reason)
+        for _, type_, status, reason in pipe.hpa.condition_history
+        if type_ == "ScalingActive"
+    ]
+    assert (True, "ValidMetricFound") == reasons[0]
+    assert (False, "FailedGetObjectMetric") in reasons
+    assert reasons.index((False, "FailedGetObjectMetric")) < len(reasons) - 1
+    assert reasons[-1] == (True, "ValidMetricFound")
+
+
+def test_condition_last_transition_time_sticks_while_reason_stable():
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 35.0, nodes=1)
+    clock.advance(60.0)
+    first = pipe.hpa.status.condition("ScalingActive").last_transition_time
+    clock.advance(120.0)  # many syncs later, still True
+    assert pipe.hpa.status.condition("ScalingActive").last_transition_time == first
+
+
+def test_adapter_blackout_flips_condition_while_up_stays_green():
+    """L4 down is not L3 down: scrapes keep succeeding (up==1 everywhere)
+    while the HPA reports it cannot read its metric — the conditions point
+    at the right layer."""
+    clock, cluster, dep, pipe = make_pipeline(lambda t: 35.0, nodes=1)
+    clock.advance(60.0)
+    schedule = ChaosSchedule(
+        pipe, [FaultSpec("adapter_blackout", at=0.0, duration=60.0)]
+    )
+    schedule.arm()
+    clock.advance(45.0)
+    assert pipe.hpa.status.condition("ScalingActive").status is False
+    for target in pipe.scraper.targets:
+        assert pipe.db.latest("up", {"target": target.name}) == 1.0
+    clock.advance(120.0)
+    assert pipe.hpa.status.condition("ScalingActive").status is True
+    assert schedule.all_recovered()
+
+
+# ---- SimCluster lifecycle ---------------------------------------------------
+
+
+def two_node_cluster():
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock, nodes=[("n0", 2), ("n1", 2)], pod_start_latency=5.0
+    )
+    dep = SimDeployment(cluster, "d", "d", load_fn=lambda t: 10.0)
+    cluster.add_deployment(dep, replicas=3)
+    clock.advance(10.0)
+    return clock, cluster, dep
+
+
+def test_preempt_reclaims_chips_and_reschedules():
+    clock, cluster, dep = two_node_cluster()
+    assert len(cluster.running_pods("d")) == 3
+    node = cluster.nodes["n0"]
+    assert node.allocations
+
+    cluster.preempt_node("n0")
+    assert not node.ready and not node.schedulable
+    assert node.allocations == {}
+    # survivors on n1 only; the displaced pod waits Pending (2 chips < 3 pods)
+    assert all(p.node == "n1" for p in cluster.running_pods("d"))
+    assert len(cluster.deployment_pods("d")) == 3
+    clock.advance(30.0)
+    assert len(cluster.running_pods("d")) == 2, "no capacity until restore"
+    # a preempted node's exporter is unreachable, not just stale
+    with pytest.raises(ConnectionError):
+        cluster.exporter_fetch("n0")
+
+    cluster.restore_node("n0")
+    assert node.ready and node.schedulable
+    clock.advance(15.0)  # pending requeue (5s) + start latency (5s)
+    assert len(cluster.running_pods("d")) == 3
+
+
+def test_drain_evicts_but_keeps_node_and_exporter_up():
+    clock, cluster, dep = two_node_cluster()
+    cluster.drain_node("n0")
+    node = cluster.nodes["n0"]
+    assert node.ready and not node.schedulable
+    cluster.exporter_fetch("n0")  # still serving (no pods to report, but up)
+    clock.advance(30.0)
+    assert all(p.node == "n1" for p in cluster.running_pods("d"))
+    cluster.restore_node("n0")
+    clock.advance(15.0)
+    assert len(cluster.running_pods("d")) == 3
+
+
+def test_crashloop_backoff_doubles_and_recovers():
+    clock, cluster, dep = two_node_cluster()
+    cluster.start_crashloop("d")
+    victim = cluster.running_pods("d")[0].name
+    cluster.kill_pod(victim)
+    clock.advance(6.0)  # replacement tries to start after 5s latency, crashes
+    looping = [p for p in cluster.deployment_pods("d") if p.phase == "CrashLoopBackOff"]
+    assert len(looping) == 1
+    pod = looping[0]
+    assert pod.restart_count == 1
+    clock.advance(10.5)  # first restart delay: 10s after the t=15 attempt
+    assert pod.restart_count == 2
+    clock.advance(18.0)  # second delay doubles to 20s (due t=45) — not yet
+    assert pod.restart_count == 2
+    clock.advance(2.0)
+    assert pod.restart_count == 3
+
+    cluster.stop_crashloop("d")
+    clock.advance(45.0)  # third delay: 40s, then the attempt succeeds
+    assert pod.phase == "Running"
+    assert len(cluster.running_pods("d")) == 3
+
+
+def test_unknown_names_raise():
+    clock, cluster, dep = two_node_cluster()
+    with pytest.raises(KeyError):
+        cluster.preempt_node("nope")
+    with pytest.raises(KeyError):
+        cluster.start_crashloop("nope")
+    with pytest.raises(ValueError):
+        FaultSpec("no_such_kind", at=0.0)
+
+
+# ---- the storm --------------------------------------------------------------
+
+
+def test_fault_storm_recovers_everything_with_bounded_mttr():
+    result = run_fault_storm()
+    assert result["settled_replicas"] == 3
+    assert result["all_recovered"], result["faults"]
+    assert result["spurious_scale_events_during_blackout"] == 0
+    assert result["blackout_condition_observed"]
+    assert result["final_replicas"] == result["settled_replicas"]
+    assert result["final_running"] == result["settled_replicas"]
+    for fault in result["faults"]:
+        assert fault["mttr"] is not None and fault["mttr"] < 180.0, fault

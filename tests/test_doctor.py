@@ -149,12 +149,12 @@ def test_diagnose_stops_at_first_broken_joint():
 
 def test_diagnose_skips_absent_fetchers():
     results = diagnose(exporter_fetch=lambda: exposition())
-    # L2 + L3 + L4 + L5 + operator + alerts
-    assert [r.ok for r in results] == [True] * 6
+    # L2 + L3 + L3 scrape health + L4 + L5 + operator + alerts
+    assert [r.ok for r in results] == [True] * 7
     assert results[1].detail.startswith("skipped")
 
 
-def test_diagnose_against_live_native_exporter():
+def test_diagnose_against_live_native_exporter(native_built):
     """End-to-end over real HTTP: the native C++ exporter serves /metrics and
     the doctor's L2 probe passes against it."""
     import urllib.request

@@ -12,7 +12,7 @@ import urllib.request
 import pytest
 
 from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
-from k8s_gpu_hpa_tpu.exporter.native import NativeExporter, build_native
+from k8s_gpu_hpa_tpu.exporter.native import NativeExporter
 from k8s_gpu_hpa_tpu.exporter.podresources import StaticAttributor
 from k8s_gpu_hpa_tpu.exporter.sources import StubSource
 from k8s_gpu_hpa_tpu.metrics.exposition import encode_text, parse_text
@@ -25,8 +25,9 @@ from k8s_gpu_hpa_tpu.metrics.schema import (
 
 
 @pytest.fixture(scope="module", autouse=True)
-def built():
-    build_native()
+def built(native_built):
+    """Session-shared build-or-skip (conftest.py): absent toolchain means
+    skip, not FileNotFoundError."""
 
 
 def chips_fixture():

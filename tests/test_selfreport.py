@@ -10,7 +10,7 @@ import time
 import urllib.request
 
 from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
-from k8s_gpu_hpa_tpu.exporter.native import build_native
+from conftest import build_native_or_skip
 from k8s_gpu_hpa_tpu.exporter.selfreport import SelfReportReader, merge_reports
 from k8s_gpu_hpa_tpu.exporter.sources import LibtpuSource
 from k8s_gpu_hpa_tpu.exporter.stub_libtpu import StubLibtpuServer
@@ -213,7 +213,7 @@ def test_queue_gauge_requires_kubelet_attribution(tmp_path):
     """The trust gate: a report claiming an identity the kubelet doesn't
     place on this node exports NOTHING — chip gauges or queue depth — so a
     rogue pod can't drive the External HPA with a fabricated queue."""
-    build_native()
+    build_native_or_skip()
     rogue = TelemetryWriter(
         directory=str(tmp_path), pod="evil-pod", namespace="default"
     )
@@ -273,7 +273,7 @@ def test_memory_bound_divergence_end_to_end(tmp_path):
     production path: libtpu gRPC + telemetry file → daemon merge → C++ render.
     Also proves the bw fallback (VERDICT.md #3): libtpu has no bw metric
     (_bw_supported False) yet the serve signal exists, from the workload."""
-    build_native()
+    build_native_or_skip()
     # the workload: memory-bound decode — busy 96% of the time, MXU ~7%
     writer = TelemetryWriter(
         directory=str(tmp_path), pod="tpu-serve-abc", namespace="default"
@@ -339,7 +339,7 @@ def test_serve_rung_closed_loop_on_selfreported_bw(tmp_path):
     from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
     from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
 
-    build_native()
+    build_native_or_skip()
     hpa_doc = yaml.safe_load(
         (pathlib.Path(__file__).parent.parent / "deploy/tpu-serve-hpa.yaml").read_text()
     )
@@ -408,7 +408,7 @@ def test_serve_rung_closed_loop_on_selfreported_bw(tmp_path):
     assert target.replicas >= 2, (target.replicas, hpa.status)
 
 
-def test_daemon_queue_fn_hook_serves_queue_gauges():
+def test_daemon_queue_fn_hook_serves_queue_gauges(native_built):
     """The stub queue knob (kind-e2e legs 9-10): a daemon-level queue_fn
     producer paints tpu_test_queue_depth without any self-report plumbing —
     the file-knob analog of STUB_UTIL for the External rung."""

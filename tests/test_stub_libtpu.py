@@ -11,7 +11,7 @@ import urllib.request
 import pytest
 
 from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
-from k8s_gpu_hpa_tpu.exporter.native import build_native
+from conftest import build_native_or_skip
 from k8s_gpu_hpa_tpu.exporter.sources import (
     LIBTPU_DUTY_CYCLE,
     LIBTPU_HBM_TOTAL,
@@ -92,7 +92,7 @@ def test_daemon_serves_stub_libtpu_metrics_over_http():
     """Production wiring end-to-end: stub 8431 → gRPC → LibtpuSource → C++
     core → /metrics text, the automated analog of the reference's exporter
     curl probe (README.md:42-47)."""
-    build_native()
+    build_native_or_skip()
     with StubLibtpuServer(num_chips=2) as server:
         source = LibtpuSource(address=server.address)
         with ExporterDaemon(
@@ -319,7 +319,7 @@ def test_metric_field_filter_restricts_exposition():
     TPU_METRIC_FIELDS knob restricts which families render."""
     from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
 
-    build_native()
+    build_native_or_skip()
     with StubLibtpuServer(num_chips=2) as server:
         source = LibtpuSource(address=server.address)
         with ExporterDaemon(
@@ -351,7 +351,7 @@ def test_metric_field_filter_rejects_unknown_names():
     from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
     from k8s_gpu_hpa_tpu.exporter.sources import StubSource
 
-    build_native()
+    build_native_or_skip()
     with _pytest.raises(ValueError, match="tpu_duty_cyle"):
         ExporterDaemon(
             StubSource(num_chips=1),
@@ -554,7 +554,7 @@ def test_unmapped_advertised_none_without_capability_rpc():
             source.close()
 
 
-def test_daemon_logs_unmapped_once(capsys):
+def test_daemon_logs_unmapped_once(capsys, native_built):
     """The daemon's first good sweep prints advertised-but-unconsumed names
     exactly once, so an on-node operator sees them in `kubectl logs`."""
     from k8s_gpu_hpa_tpu.exporter.daemon import ExporterDaemon
