@@ -1602,6 +1602,67 @@ def run_rung_chaos() -> dict:
     }
 
 
+def run_rung_signal_latency() -> dict:
+    """Signal-propagation latency rung (obs/latency.py): a traced pipeline
+    under a staircase of upward load steps measures, per step, how long the
+    control plane takes to *notice* (workload_change -> first hpa_sync) and
+    to *act* (workload_change -> scale_event) — the decomposition of the
+    north-star 60 s budget that the headline trial only measures end-to-end.
+    Virtual time: the distributions are deterministic run-to-run."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+    from k8s_gpu_hpa_tpu.obs import TracedLoad, Tracer, propagation_report
+
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock, nodes=[("n0", 8)], pod_start_latency=BASE_POD_START_LATENCY
+    )
+
+    def offered(t: float) -> float:
+        # three upward steps, each far enough apart that the loop settles:
+        # 35 holds 1 replica; 90 -> 3; 140 -> 4; 200 -> 5 (target 40, shared)
+        if t < 60.0:
+            return 35.0
+        if t < 180.0:
+            return 90.0
+        if t < 300.0:
+            return 140.0
+        return 200.0
+
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", load_fn=offered, load_mode="shared"
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    base = clock.now()
+    tracer = Tracer(clock)
+    dep.load_fn = TracedLoad(lambda t: offered(t - base), tracer)
+    pipe = AutoscalingPipeline(
+        cluster, dep, target_value=TARGET, max_replicas=8, tracer=tracer
+    )
+    pipe.run_for(420.0)
+
+    prop = propagation_report(tracer.spans)
+    budget = 60.0
+    return {
+        "mode": "virtual",
+        "metric": "signal propagation latency (s, workload change -> sync/scale)",
+        "changes_total": prop["changes_total"],
+        "changes_scaled": prop["changes_scaled"],
+        "sync_latency_p50_s": prop["sync_latency_p50"],
+        "sync_latency_p95_s": prop["sync_latency_p95"],
+        "scale_latency_p50_s": prop["scale_latency_p50"],
+        "scale_latency_p95_s": prop["scale_latency_p95"],
+        "budget_s": budget,
+        "within_budget": (
+            prop["scale_latency_p95"] is not None
+            and prop["scale_latency_p95"] <= budget
+        ),
+        "trace_spans": len(tracer.spans),
+        "final_replicas": pipe.replicas(),
+    }
+
+
 # ---- pod-start sensitivity sweep (VERDICT r3 #5) ---------------------------
 
 
@@ -1999,6 +2060,7 @@ def main() -> None:
             ("external_queue", run_rung_external_queue),
             ("4_multihost_quantum", run_rung_multihost_quantum),
             ("chaos_storm", run_rung_chaos),
+            ("signal_latency", run_rung_signal_latency),
         ):
             log(f"rung {name}:")
             try:

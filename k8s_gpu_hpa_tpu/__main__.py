@@ -196,11 +196,16 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
     sim.add_argument(
         "--scenario",
-        choices=["spike", "ramp", "flap", "outage", "crash", "chaos"],
+        choices=["spike", "ramp", "flap", "outage", "crash", "chaos", "trace"],
         default="spike",
     )
     sim.add_argument("--duration", type=float, default=420.0)
     sim.add_argument("--pod-start", type=float, default=12.0)
+    sim.add_argument(
+        "--trace-out",
+        default="trace.jsonl",
+        help="JSONL span export path for --scenario trace",
+    )
     sim.add_argument(
         "--saturated-pct",
         type=float,

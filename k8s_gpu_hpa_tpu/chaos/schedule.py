@@ -43,6 +43,9 @@ class RecoveryReport:
     detected_at: float | None = None
     recovered_at: float | None = None
     expected_replicas: int | None = None
+    #: id of the fault_window span covering injected→recovered, when the
+    #: pipeline is traced — the hook from chaos reports into the trace
+    trace_span_id: int | None = None
 
     @property
     def detection_time(self) -> float | None:
@@ -81,6 +84,7 @@ class RecoveryReport:
             "degraded_duration": r(self.degraded_duration),
             "mttr": r(self.mttr),
             "recovered": self.recovered,
+            "trace_span_id": self.trace_span_id,
         }
 
 
@@ -199,9 +203,31 @@ class ChaosSchedule:
                     if now - current.healthy_since >= self.stable_for:
                         report.recovered_at = current.healthy_since
                         current.resolved = True
+                        self._annotate_trace(report)
                 else:
                     current.healthy_since = None
         self.pipeline.clock.call_later(self.monitor_interval, self._tick)
+
+    def _annotate_trace(self, report: RecoveryReport) -> None:
+        """On a traced pipeline, emit a ``fault_window`` span whose
+        start/end ARE the fault's injected→recovered window, and remember
+        its id on the report — the bridge from chaos accounting into the
+        trace (a scale event during the window can be read against it)."""
+        tracer = getattr(self.pipeline, "tracer", None)
+        if tracer is None or report.injected_at is None:
+            return
+        attrs = {"fault": report.fault.name, "kind": report.fault.kind}
+        if report.detected_at is not None:
+            attrs["detected_at"] = report.detected_at
+        if report.mttr is not None:
+            attrs["mttr"] = report.mttr
+        span = tracer.emit(
+            "fault_window",
+            attrs,
+            start=report.injected_at,
+            end=report.recovered_at,
+        )
+        report.trace_span_id = span.span_id
 
     def all_recovered(self) -> bool:
         return all(a.report.recovered for a in self._armed)

@@ -32,6 +32,7 @@ from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
 from k8s_gpu_hpa_tpu.control.hpa import HPABehavior
 from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.obs import Tracer
 from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
 
 STORM_FAULTS = [
@@ -64,8 +65,12 @@ def run_fault_storm(
     behavior = HPABehavior()
     behavior.scale_down.stabilization_window_seconds = 60.0
 
+    # traced: each resolved fault's RecoveryReport carries the id of a
+    # fault_window span covering its degraded window (schedule.py)
+    tracer = Tracer(clock)
     pipe = AutoscalingPipeline(
-        cluster, dep, target_value=40.0, max_replicas=4, behavior=behavior
+        cluster, dep, target_value=40.0, max_replicas=4, behavior=behavior,
+        tracer=tracer,
     )
     pipe.start()
     clock.advance(120.0)  # settle: shared 90 % over target 40 ⇒ 3 replicas
@@ -105,6 +110,10 @@ def run_fault_storm(
         "final_replicas": pipe.replicas(),
         "final_running": pipe.running(),
         "scale_events": len(pipe.scale_history),
+        "trace_spans": len(tracer.spans),
+        "fault_window_spans": [
+            s.span_id for s in tracer.spans_of("fault_window")
+        ],
     }
 
 

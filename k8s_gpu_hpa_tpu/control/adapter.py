@@ -78,10 +78,36 @@ class CustomMetricsAdapter:
         db: TimeSeriesDB,
         rules: list[AdapterRule],
         external_rules: list[ExternalRule] | None = None,
+        tracer=None,
     ):
         self.db = db
         self.rules = {r.metric_name: r for r in rules}
         self.external_rules = {r.metric_name: r for r in (external_rules or [])}
+        #: obs.Tracer: every metric query emits an ``adapter_query`` span
+        #: linked to the rule_eval/scrape spans that wrote the points it read
+        self.tracer = tracer
+
+    def _traced(self, api: str, metric: str, query, found):
+        """Run ``query`` under an ``adapter_query`` span whose links are the
+        origins of every TSDB point the query read (DB read capture); ``found``
+        maps the result to the span's served/empty flag."""
+        if self.tracer is None:
+            return query()
+        span = self.tracer.open("adapter_query", {"api": api, "metric": metric})
+        self.db.begin_capture()
+        ok = False
+        result = None
+        try:
+            result = query()
+            ok = found(result)
+            return result
+        finally:
+            reads = self.db.end_capture()
+            links = tuple({r[4] for r in reads if r[4] is not None})
+            attrs: dict = {"found": ok}
+            if ok and isinstance(result, (int, float)):
+                attrs["value"] = float(result)
+            self.tracer.close(span, links, **attrs)
 
     def list_metrics(self) -> list[str]:
         """API discovery: the set of metric names the adapter exposes — what the
@@ -106,6 +132,14 @@ class CustomMetricsAdapter:
         Staleness falls out of the TSDB lookback window — a dead pipeline stops
         answering, which makes the HPA hold its last decision (K8s semantics for
         failed metric queries)."""
+        return self._traced(
+            "object",
+            metric_name,
+            lambda: self._object_metric(ref, metric_name),
+            lambda r: r is not None,
+        )
+
+    def _object_metric(self, ref: ObjectReference, metric_name: str) -> float | None:
         rule = self.rules.get(metric_name)
         if rule is None:
             return None
@@ -139,6 +173,16 @@ class CustomMetricsAdapter:
         series are absent from the result — the HPA's missing-metric handling
         decides what that means.
         """
+        return self._traced(
+            "pods",
+            metric_name,
+            lambda: self._pods_metric(namespace, metric_name, pod_names),
+            lambda r: bool(r),
+        )
+
+    def _pods_metric(
+        self, namespace: str, metric_name: str, pod_names: list[str]
+    ) -> dict[str, float]:
         rule = self.rules.get(metric_name)
         if rule is None:
             return {}
@@ -172,6 +216,19 @@ class CustomMetricsAdapter:
     ) -> list[float]:
         """All values of an External metric matching the label selector —
         ``external.metrics.k8s.io`` returns a list; the HPA sums it."""
+        return self._traced(
+            "external",
+            metric_name,
+            lambda: self._external_metric(namespace, metric_name, selector),
+            lambda r: bool(r),
+        )
+
+    def _external_metric(
+        self,
+        namespace: str,
+        metric_name: str,
+        selector: dict[str, str] | None = None,
+    ) -> list[float]:
         rule = self.external_rules.get(metric_name)
         if rule is None:
             return []

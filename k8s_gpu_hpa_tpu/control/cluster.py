@@ -164,12 +164,21 @@ class _NodeExporter:
         self.sample_interval = sample_interval
         self._cache: str | None = None
         self._last_sample = -float("inf")
+        #: span id of the collection sweep behind the current cache — the
+        #: lineage root a scrape of this exporter links to (a cache hit
+        #: correctly keeps the OLD sweep's id: the data really is that old)
+        self.last_span_id: int | None = None
 
     def fetch(self) -> str:
         now = self.cluster.clock.now()
         if self._cache is None or now - self._last_sample >= self.sample_interval:
             self._cache = self._collect()
             self._last_sample = now
+            if self.cluster.tracer is not None:
+                self.last_span_id = self.cluster.tracer.emit(
+                    "exporter_sample",
+                    {"node": self.node.name, "chips": self.node.num_chips},
+                ).span_id
         return self._cache
 
     def _collect(self) -> str:
@@ -209,8 +218,13 @@ class SimCluster:
         nodes: list[tuple[str, int]] | None = None,
         pod_start_latency: float = 12.0,
         exporter_sample_interval: float = 1.0,
+        tracer=None,
     ):
         self.clock = clock
+        #: obs.Tracer: each fresh exporter collection sweep emits an
+        #: ``exporter_sample`` span — the root of every metric lineage.
+        #: Settable after construction (control/loop.py wires it in).
+        self.tracer = tracer
         self.nodes = {
             name: SimNode(name, chips) for name, chips in (nodes or [("tpu-node-0", 8)])
         }
@@ -371,6 +385,11 @@ class SimCluster:
         if not self.nodes[node_name].ready:
             raise ConnectionError(f"node {node_name} is down (preempted)")
         return self.exporters[node_name].fetch()
+
+    def exporter_sample_span(self, node_name: str) -> int | None:
+        """Span id of the collection sweep behind the node exporter's current
+        cache (ScrapeTarget.trace_origin provider)."""
+        return self.exporters[node_name].last_span_id
 
     def kube_state_metrics_text(self) -> str:
         """``kube_pod_labels`` for every pod (kube-state-metrics exports Pending

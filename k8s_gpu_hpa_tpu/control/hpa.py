@@ -22,6 +22,7 @@ reference semantics from which deploy/tpu-test-hpa.yaml is generated.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
@@ -340,6 +341,8 @@ class HPAController:
         resource_metrics: ResourceMetricsReader | None = None,
         pod_lister: PodLister | None = None,
         namespace: str = "default",
+        tracer=None,
+        selfmetrics=None,
     ):
         self.target = target
         self.metrics = metrics
@@ -367,6 +370,12 @@ class HPAController:
         self.resource_metrics = resource_metrics
         self.pod_lister = pod_lister
         self.namespace = namespace
+        #: obs.Tracer: each sync emits an ``hpa_sync`` span linked to the
+        #: adapter_query spans it consulted, plus a ``scale_event`` span when
+        #: replicas changed — the decision end of metric lineage
+        self.tracer = tracer
+        #: obs.PipelineSelfMetrics: sync durations + decision counter
+        self.selfmetrics = selfmetrics
         self.status = HPAStatus(current_replicas=target.replicas)
         #: (ts, type, status, reason) log of every condition status/reason
         #: change, for tests and the chaos monitor (real HPAs only keep the
@@ -521,6 +530,45 @@ class HPAController:
         return stabilized
 
     def sync_once(self) -> HPAStatus:
+        """One sync pass.  Untraced, this is exactly the v2 algorithm
+        (``_sync_inner``); traced, the pass runs inside an ``hpa_sync`` span
+        that collects the adapter_query spans it triggered (tracer scope) and,
+        when replicas change, is followed by a ``scale_event`` span — the root
+        every lineage walk starts from."""
+        if self.tracer is None and self.selfmetrics is None:
+            return self._sync_inner()
+        before = self.target.replicas
+        wall_start = time.perf_counter()
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.open("hpa_sync")
+            self.tracer.push_scope()
+        try:
+            status = self._sync_inner()
+        finally:
+            children = self.tracer.pop_scope() if self.tracer is not None else ()
+        duration = time.perf_counter() - wall_start
+        if self.selfmetrics is not None:
+            self.selfmetrics.observe_sync(duration, status.last_reason)
+        if span is not None:
+            self.tracer.close(
+                span,
+                children,
+                reason=status.last_reason,
+                current_replicas=before,
+                desired_replicas=status.desired_replicas,
+                duration_seconds=duration,
+            )
+            after = self.target.replicas
+            if after != before:
+                self.tracer.emit(
+                    "scale_event",
+                    {"from_replicas": before, "to_replicas": after},
+                    links=(span.span_id,),
+                )
+        return status
+
+    def _sync_inner(self) -> HPAStatus:
         current = self.target.replicas
         self.status.current_replicas = current
         self._set_condition(

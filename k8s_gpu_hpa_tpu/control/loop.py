@@ -63,21 +63,49 @@ class AutoscalingPipeline:
         object_kind: str = "Deployment",  # "Deployment" | "StatefulSet"
         metric_specs: list[MetricSpec] | None = None,
         extra_adapter_rules: list[AdapterRule] | None = None,
+        tracer=None,
     ):
         self.cluster = cluster
         self.deployment = deployment
         self.intervals = intervals or PipelineIntervals()
         clock: VirtualClock = cluster.clock
 
+        # Observability wiring (obs/): pass an obs.Tracer to get spans from
+        # every stage, PipelineSelfMetrics served as one more scrape target,
+        # and full metric lineage on every scale event.  With tracer=None
+        # (the default) every stage takes its zero-overhead untraced path.
+        self.tracer = tracer
+        self.selfmetrics = None
+        if tracer is not None:
+            from k8s_gpu_hpa_tpu.obs import SELF_TARGET_NAME, PipelineSelfMetrics
+
+            cluster.tracer = tracer
+            self.selfmetrics = PipelineSelfMetrics()
+
         self.db = TimeSeriesDB(clock)
-        self.scraper = Scraper(self.db, interval=self.intervals.scrape)
+        self.scraper = Scraper(
+            self.db,
+            interval=self.intervals.scrape,
+            tracer=tracer,
+            selfmetrics=self.selfmetrics,
+        )
         for node_name in cluster.nodes:
-            self.scraper.add_target(
+            target = self.scraper.add_target(
                 lambda n=node_name: cluster.exporter_fetch(n),
                 name=f"exporter/{node_name}",
                 node=node_name,
             )
+            if tracer is not None:
+                target.trace_origin = (
+                    lambda n=node_name: cluster.exporter_sample_span(n)
+                )
         self.scraper.add_target(cluster.kube_state_metrics_text, name="kube-state-metrics")
+        if self.selfmetrics is not None:
+            # the pipeline scrapes its own self-metrics like any other target,
+            # so they land in the same TSDB / dashboard / doctor probes
+            self.scraper.add_target(
+                self.selfmetrics.exposition, name=SELF_TARGET_NAME
+            )
 
         if object_kind == "StatefulSet":
             # multi-host rung: the series is addressed at the StatefulSet
@@ -95,7 +123,13 @@ class AutoscalingPipeline:
                 record=record,
             )
         rules = [primary] + (extra_rules or [])
-        self.evaluator = RuleEvaluator(self.db, rules, interval=self.intervals.rule_eval)
+        self.evaluator = RuleEvaluator(
+            self.db,
+            rules,
+            interval=self.intervals.rule_eval,
+            tracer=tracer,
+            selfmetrics=self.selfmetrics,
+        )
 
         def overrides_for(rule: RecordingRule) -> dict[str, str]:
             # each rule's series is addressed at whatever object kind its own
@@ -119,6 +153,7 @@ class AutoscalingPipeline:
                 for r in rules
             ]
             + (extra_adapter_rules or []),
+            tracer=tracer,
         )
 
         ref = ObjectReference(object_kind, deployment.name, deployment.namespace)
@@ -147,6 +182,8 @@ class AutoscalingPipeline:
             replica_quantum=replica_quantum,
             pod_lister=deployment,
             namespace=deployment.namespace,
+            tracer=tracer,
+            selfmetrics=self.selfmetrics,
         )
         self.scale_history: list[tuple[float, int, int]] = []  # (ts, from, to)
         self.hpa.on_scale = lambda a, b: self.scale_history.append((clock.now(), a, b))

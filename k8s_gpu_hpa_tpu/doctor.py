@@ -19,7 +19,9 @@ Two frontends share the probe definitions:
 
 Env for the CLI: EXPORTER_URL (default http://localhost:9400/metrics),
 PROM_URL (default http://localhost:9090), METRIC (default
-tpu_test_tensorcore_avg), HPA / NAMESPACE for the HPA check.
+tpu_test_tensorcore_avg), HPA / NAMESPACE for the HPA check,
+SELF_METRICS=1 to probe the pipeline self-metrics series (only meaningful
+where the in-process pipeline's ``pipeline-self`` target is being scraped).
 """
 
 from __future__ import annotations
@@ -32,6 +34,10 @@ from typing import Callable
 
 from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
 from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS, CORE_CHIP_METRICS
+from k8s_gpu_hpa_tpu.obs.selfmetrics import SELF_METRIC_NAMES, SELF_TARGET_NAME
+
+#: one instant query covering every self-metric family (obs/selfmetrics.py)
+SELF_METRICS_QUERY = '{__name__=~"%s"}' % "|".join(SELF_METRIC_NAMES)
 
 
 @dataclass
@@ -149,6 +155,43 @@ def check_scrape_up(payload: str) -> str:
     return f"all {len(results)} scrape targets up"
 
 
+def check_self_metrics(payload: str) -> str:
+    """Pipeline self-observation: every self-metric family present and fresh
+    (mirror of :func:`check_scrape_up` for the ``pipeline-self`` target).
+    An instant query only returns points inside the staleness/lookback
+    window, so presence here IS freshness; beyond presence, the probe
+    demands a ``scrape_duration_seconds`` sample for the pipeline-self
+    target itself — the self-monitoring loop closing over its own scrape.
+    ``payload`` is the instant-query JSON for :data:`SELF_METRICS_QUERY`."""
+    doc = json.loads(payload)
+    if doc.get("status") != "success":
+        raise AssertionError(f"prometheus query failed: {doc}")
+    results = doc["data"]["result"]
+    if not results:
+        raise AssertionError(
+            "no pipeline self-metric series at all: the pipeline is not "
+            "traced/instrumented, or its pipeline-self target is not scraped"
+        )
+    found = {r["metric"].get("__name__", "") for r in results}
+    missing = [n for n in SELF_METRIC_NAMES if n not in found]
+    if missing:
+        raise AssertionError(
+            f"self-metric families missing or stale: {missing} "
+            f"(got {sorted(found)})"
+        )
+    self_scraped = any(
+        r["metric"].get("__name__") == "scrape_duration_seconds"
+        and r["metric"].get("target") == SELF_TARGET_NAME
+        for r in results
+    )
+    if not self_scraped:
+        raise AssertionError(
+            f"no scrape_duration_seconds sample for target={SELF_TARGET_NAME!r}: "
+            "the self-metrics target is served but not being scraped"
+        )
+    return f"all {len(SELF_METRIC_NAMES)} self-metric families fresh ({len(results)} series)"
+
+
 def check_custom_metrics_api(payload: str, metric: str) -> str:
     """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
     doc = json.loads(payload)
@@ -239,6 +282,7 @@ def diagnose(
     alerts_fetch: Callable[[], str] | None = None,
     operator_fetch: Callable[[], str] | None = None,
     up_fetch: Callable[[], str] | None = None,
+    self_metrics_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -260,6 +304,13 @@ def diagnose(
             "L3 scrape health",
             "every scrape target serving (up==1)",
             (lambda: check_scrape_up(up_fetch())) if up_fetch else None,
+        ),
+        (
+            "L3 self-metrics",
+            "pipeline self-metric families present and fresh",
+            (lambda: check_self_metrics(self_metrics_fetch()))
+            if self_metrics_fetch
+            else None,
         ),
         (
             "L4 custom-metrics API",
@@ -471,6 +522,17 @@ def main() -> int:
         operator_fetch=(
             (lambda: _http_fetch(os.environ["OPERATOR_URL"]))
             if os.environ.get("OPERATOR_URL")
+            else None
+        ),
+        # optional: the self-metric families only exist where the in-process
+        # pipeline's pipeline-self target is scraped — SELF_METRICS=1 opts in
+        self_metrics_fetch=(
+            (
+                lambda: _http_fetch(
+                    f"{prom_url}/api/v1/query?query={quote(SELF_METRICS_QUERY)}"
+                )
+            )
+            if os.environ.get("SELF_METRICS")
             else None
         ),
     )

@@ -265,24 +265,53 @@ class RecordingRule:
     labels: dict[str, str] = field(default_factory=dict)
     _last_keys: set[tuple[tuple[str, str], ...]] = field(default_factory=set, repr=False)
 
-    def evaluate_into(self, db: TimeSeriesDB, at: float | None = None) -> int:
+    def evaluate_into(
+        self,
+        db: TimeSeriesDB,
+        at: float | None = None,
+        tracer=None,
+        selfmetrics=None,
+    ) -> int:
         """Evaluate and write the result series back into the TSDB.  Output
         series that stop being produced get staleness markers (Prometheus rule
         semantics) so a broken input pipeline propagates to consumers instead of
-        serving a frozen value for the whole lookback window."""
+        serving a frozen value for the whole lookback window.
+
+        With a tracer, the evaluation emits a ``rule_eval`` span linked to the
+        scrape spans that produced every point the expression read (the DB's
+        read capture), and stamps its own span id as the origin of the output
+        points — the middle hop of metric lineage."""
         count = 0
         ts = db.clock.now() if at is None else at
+        span = tracer.open("rule_eval", {"rule": self.record}) if tracer else None
+        origin = None if span is None else span.span_id
+        capturing = tracer is not None or selfmetrics is not None
+        if capturing:
+            db.begin_capture()
+        try:
+            outputs = self.expr.evaluate(db, at)
+        finally:
+            reads = db.end_capture() if capturing else []
         produced: set[tuple[tuple[str, str], ...]] = set()
-        for sample in self.expr.evaluate(db, at):
+        for sample in outputs:
             labels = dict(sample.labels)
             labels.update(self.labels)
             key = tuple(sorted(labels.items()))
-            db.append(self.record, key, sample.value, ts)
+            db.append(self.record, key, sample.value, ts, origin=origin)
             produced.add(key)
             count += 1
         for key in self._last_keys - produced:
-            db.mark_stale(self.record, key, ts)
+            db.mark_stale(self.record, key, ts, origin=origin)
         self._last_keys = produced
+        staleness = ts - max(r[2] for r in reads) if reads else None
+        if selfmetrics is not None and staleness is not None:
+            selfmetrics.observe_rule_eval(self.record, staleness)
+        if span is not None:
+            links = tuple({r[4] for r in reads if r[4] is not None})
+            attrs = {"samples_out": count}
+            if staleness is not None:
+                attrs["staleness_seconds"] = staleness
+            tracer.close(span, links, **attrs)
         return count
 
 
@@ -298,14 +327,25 @@ class RuleEvaluator:
         rules: list[RecordingRule],
         interval: float = 1.0,
         alerts: list[AlertRule] | None = None,
+        tracer=None,
+        selfmetrics=None,
     ):
         self.db = db
         self.rules = rules
         self.interval = interval
         self.alerts = alerts or []
+        #: obs.Tracer / obs.PipelineSelfMetrics, threaded into every
+        #: rule evaluation (rule_eval spans + staleness gauges)
+        self.tracer = tracer
+        self.selfmetrics = selfmetrics
 
     def evaluate_once(self) -> int:
-        count = sum(rule.evaluate_into(self.db) for rule in self.rules)
+        count = sum(
+            rule.evaluate_into(
+                self.db, tracer=self.tracer, selfmetrics=self.selfmetrics
+            )
+            for rule in self.rules
+        )
         for alert in self.alerts:
             alert.evaluate(self.db)
         return count

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import math
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -32,18 +33,27 @@ LabelSet = tuple[tuple[str, str], ...]
 @dataclass
 class _Series:
     labels: LabelSet
-    points: list[tuple[float, float]] = field(default_factory=list)  # (ts, value)
+    #: (ts, value, origin) — origin is the span id of the pipeline stage
+    #: that wrote the point (obs/trace.py), or None when untraced
+    points: list[tuple[float, float, int | None]] = field(default_factory=list)
 
-    def latest_at(self, at: float, lookback: float) -> float | None:
+    def latest_point_at(
+        self, at: float, lookback: float
+    ) -> tuple[float, float, int | None] | None:
         # Points arrive in time order; scan from the end.  A NaN point is a
         # staleness marker (Prometheus semantics: written when a scrape fails or
         # a rule's output series disappears) and ends the series immediately.
-        for ts, value in reversed(self.points):
+        for point in reversed(self.points):
+            ts, value = point[0], point[1]
             if ts <= at:
                 if math.isnan(value) or at - ts > lookback:
                     return None
-                return value
+                return point
         return None
+
+    def latest_at(self, at: float, lookback: float) -> float | None:
+        point = self.latest_point_at(at, lookback)
+        return None if point is None else point[1]
 
 
 class TimeSeriesDB:
@@ -53,13 +63,37 @@ class TimeSeriesDB:
         self.clock = clock or SystemClock()
         self.lookback = lookback
         self._data: dict[str, dict[LabelSet, _Series]] = {}
+        #: active read-capture sink (see begin_capture), else None
+        self._capture: list[tuple[str, LabelSet, float, float, int | None]] | None = None
 
     def append(
-        self, name: str, labels: LabelSet, value: float, ts: float | None = None
+        self,
+        name: str,
+        labels: LabelSet,
+        value: float,
+        ts: float | None = None,
+        origin: int | None = None,
     ) -> None:
         ts = self.clock.now() if ts is None else ts
         series = self._data.setdefault(name, {}).setdefault(labels, _Series(labels))
-        series.points.append((ts, value))
+        series.points.append((ts, value, origin))
+
+    # ---- read capture (metric lineage) ------------------------------------
+    #
+    # Rule evaluations and adapter queries learn their exact inputs by
+    # bracketing their reads: every point an instant query returns while a
+    # capture is active is recorded with its origin span id.  This keeps
+    # lineage out of the expression AST and the adapter's query logic — the
+    # DB is the one chokepoint every read goes through.
+
+    def begin_capture(self) -> None:
+        self._capture = []
+
+    def end_capture(self) -> list[tuple[str, LabelSet, float, float, int | None]]:
+        """Stop capturing; returns (name, labels, ts, value, origin) per
+        point read since begin_capture."""
+        captured, self._capture = self._capture or [], None
+        return captured
 
     def instant_vector(
         self,
@@ -75,8 +109,11 @@ class TimeSeriesDB:
                 labels = dict(series.labels)
                 if any(labels.get(k) != v for k, v in matchers.items()):
                     continue
-            value = series.latest_at(at, self.lookback)
-            if value is not None:
+            point = series.latest_point_at(at, self.lookback)
+            if point is not None:
+                ts, value, origin = point
+                if self._capture is not None:
+                    self._capture.append((name, series.labels, ts, value, origin))
                 out.append(Sample(value, series.labels))
         return out
 
@@ -89,10 +126,16 @@ class TimeSeriesDB:
             raise ValueError(f"query for {name} matched {len(vec)} series, expected 1")
         return vec[0].value
 
-    def mark_stale(self, name: str, labels: LabelSet, ts: float | None = None) -> None:
+    def mark_stale(
+        self,
+        name: str,
+        labels: LabelSet,
+        ts: float | None = None,
+        origin: int | None = None,
+    ) -> None:
         """Write a staleness marker ending the series now (Prometheus writes
         these when a target fails to scrape or a rule stops producing)."""
-        self.append(name, labels, float("nan"), ts)
+        self.append(name, labels, float("nan"), ts, origin=origin)
 
     def series_names(self) -> list[str]:
         return sorted(self._data)
@@ -137,6 +180,10 @@ class ScrapeTarget:
     next_attempt_at: float = -math.inf
     #: total fetch attempts, for observability/tests
     attempts: int = 0
+    #: optional provider of the upstream span id a successful fetch's data
+    #: came from (the node exporter's last collection sweep) — the scrape
+    #: span links to it, rooting metric lineage at the raw chip samples
+    trace_origin: "Callable[[], int | None] | None" = None
 
 
 class Scraper:
@@ -159,12 +206,19 @@ class Scraper:
         backoff_base: float = 1.0,
         backoff_cap: float = 30.0,
         backoff_jitter: float = 0.1,
+        tracer=None,
+        selfmetrics=None,
     ):
         self.db = db
         self.interval = interval
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
         self.backoff_jitter = backoff_jitter
+        #: obs.Tracer: emits one ``scrape`` span per attempt and stamps its
+        #: id as the origin of every point ingested (metric lineage)
+        self.tracer = tracer
+        #: obs.PipelineSelfMetrics: per-target scrape durations
+        self.selfmetrics = selfmetrics
         #: seeded so virtual-time runs are reproducible event-for-event
         self._rng = random.Random(0)
         self.targets: list[ScrapeTarget] = []
@@ -208,9 +262,18 @@ class Scraper:
             if ts < target.next_attempt_at:
                 continue  # backing off after consecutive failures
             target.attempts += 1
+            span = (
+                self.tracer.open("scrape", {"target": target.name or "?"})
+                if self.tracer is not None
+                else None
+            )
+            origin = None if span is None else span.span_id
+            wall_start = time.perf_counter()
+            duration: float | None = None
             try:
                 fetched = target.fetch()
                 if isinstance(fetched, TimedExposition):
+                    duration = fetched.duration
                     if fetched.duration > target.deadline:
                         raise ScrapeTimeout(
                             f"{target.name or '?'}: scrape took "
@@ -220,15 +283,18 @@ class Scraper:
                     text = fetched.text
                 else:
                     text = fetched
-            except Exception:
+            except Exception as exc:
                 if target.healthy:
                     for name, labels in target.last_series:
-                        self.db.mark_stale(name, labels, ts)
+                        self.db.mark_stale(name, labels, ts, origin=origin)
                 target.healthy = False
                 target.last_series = set()
                 target.consecutive_failures += 1
                 self._backoff(target, ts)
                 self._record_up(target, 0.0, ts)
+                self._observe_scrape(target, wall_start, duration)
+                if span is not None:
+                    self.tracer.close(span, ok=False, error=str(exc))
                 continue
             target.healthy = True
             target.consecutive_failures = 0
@@ -239,12 +305,32 @@ class Scraper:
                     labels = dict(sample.labels)
                     labels.update(target.attached_labels)
                     key = tuple(sorted(labels.items()))
-                    self.db.append(fam.name, key, sample.value, ts)
+                    self.db.append(fam.name, key, sample.value, ts, origin=origin)
                     produced.add((fam.name, key))
                     count += 1
             # series that vanished from the exposition also go stale
             for name, labels in target.last_series - produced:
-                self.db.mark_stale(name, labels, ts)
+                self.db.mark_stale(name, labels, ts, origin=origin)
             target.last_series = produced
             self._record_up(target, 1.0, ts)
+            self._observe_scrape(target, wall_start, duration)
+            if span is not None:
+                links: tuple[int, ...] = ()
+                if target.trace_origin is not None:
+                    upstream = target.trace_origin()
+                    if upstream is not None:
+                        links = (upstream,)
+                self.tracer.close(span, links, ok=True, samples=len(produced))
         return count
+
+    def _observe_scrape(
+        self, target: ScrapeTarget, wall_start: float, duration: float | None
+    ) -> None:
+        """Report the scrape's duration: the modeled one when the target
+        returned a TimedExposition (virtual-time harnesses), wall-clock
+        otherwise (production semantics)."""
+        if self.selfmetrics is None:
+            return
+        if duration is None:
+            duration = time.perf_counter() - wall_start
+        self.selfmetrics.observe_scrape(target.name or "?", duration)

@@ -1,0 +1,60 @@
+"""Decision tracing, metric lineage, and pipeline self-instrumentation.
+
+The observability layer production autoscalers ship and the reference
+stack lacks entirely (SURVEY.md §5): structured spans for every pipeline
+stage (trace.py, validated against schema.py), lineage walks from scale
+events back to raw chip sweeps (lineage.py), signal-propagation latency
+measurement (latency.py), and the pipeline's own Prometheus self-metrics
+(selfmetrics.py).  Wired in by control/loop.py when a Tracer is passed to
+AutoscalingPipeline; surfaced by ``python -m k8s_gpu_hpa_tpu.simulate
+trace``, bench.py's ``signal_latency`` rung, and the chaos storm's
+span-annotated RecoveryReports.
+"""
+
+from k8s_gpu_hpa_tpu.obs.latency import (
+    TracedLoad,
+    percentile,
+    propagation_report,
+)
+from k8s_gpu_hpa_tpu.obs.lineage import format_lineage, index_spans, lineage_of
+from k8s_gpu_hpa_tpu.obs.schema import (
+    LINEAGE_ORDER,
+    SPAN_SCHEMA,
+    validate_span_fields,
+)
+from k8s_gpu_hpa_tpu.obs.selfmetrics import (
+    DECISION_REASONS,
+    HPA_DECISION_TOTAL,
+    HPA_SYNC_DURATION,
+    RULE_EVAL_STALENESS,
+    SCRAPE_DURATION,
+    SELF_METRIC_NAMES,
+    SELF_TARGET_NAME,
+    PipelineSelfMetrics,
+    decision_reason_label,
+)
+from k8s_gpu_hpa_tpu.obs.trace import Span, Tracer, read_jsonl
+
+__all__ = [
+    "DECISION_REASONS",
+    "HPA_DECISION_TOTAL",
+    "HPA_SYNC_DURATION",
+    "LINEAGE_ORDER",
+    "PipelineSelfMetrics",
+    "RULE_EVAL_STALENESS",
+    "SCRAPE_DURATION",
+    "SELF_METRIC_NAMES",
+    "SELF_TARGET_NAME",
+    "SPAN_SCHEMA",
+    "Span",
+    "TracedLoad",
+    "Tracer",
+    "decision_reason_label",
+    "format_lineage",
+    "index_spans",
+    "lineage_of",
+    "percentile",
+    "propagation_report",
+    "read_jsonl",
+    "validate_span_fields",
+]

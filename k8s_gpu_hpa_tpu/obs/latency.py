@@ -1,0 +1,124 @@
+"""Signal-propagation latency: workload change → first sync that saw it →
+scale event.
+
+The north-star latency budget (ROADMAP.md: intensity change to scale event
+under 60 s) has until now only been measured end-to-end by the bench's
+headline trial.  With the trace, the measurement decomposes: a
+``workload_change`` span pins when the offered load moved, and the
+following ``hpa_sync``/``scale_event`` spans pin when the control plane
+noticed and when it acted.  ``propagation_report`` pairs them and
+summarizes p50/p95 — the bench's ``signal_latency`` rung and the
+determinism test (tests/test_obs.py) both consume it.
+
+All timestamps are clock seconds; under VirtualClock the whole report is
+deterministic bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from k8s_gpu_hpa_tpu.obs.trace import Span, Tracer
+
+
+def percentile(values: list[float], q: float) -> float | None:
+    """Nearest-rank percentile (q in [0,100]); None on empty input."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    rank = max(1, round(q / 100.0 * len(ordered)))
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+class TracedLoad:
+    """Wrap a load function so intensity steps emit ``workload_change``
+    spans.  The span lands at the clock time the new intensity is *first
+    offered* to the cluster (the exporter's next collect evaluates the
+    load function), which is the honest start pin for propagation: before
+    that instant there is nothing for the pipeline to notice.
+
+    ``min_delta`` suppresses sub-step noise (a ramp moving 1.3/s would
+    otherwise emit every sample); the first call only records the baseline
+    — a sim starting at intensity 20 is not a change.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[float], float],
+        tracer: Tracer,
+        min_delta: float = 5.0,
+    ):
+        self.fn = fn
+        self.tracer = tracer
+        self.min_delta = min_delta
+        self._last: float | None = None
+
+    def __call__(self, t: float) -> float:
+        value = self.fn(t)
+        if self._last is None:
+            self._last = value
+        elif abs(value - self._last) >= self.min_delta:
+            self.tracer.emit(
+                "workload_change",
+                {"intensity": value, "previous": self._last},
+            )
+            self._last = value
+        return value
+
+
+def propagation_report(spans: list[Span]) -> dict:
+    """Pair each workload change with the first following HPA sync and the
+    first following scale event (both cut off at the next change — a scale
+    caused by a later step must not be credited to an earlier one).
+
+    Returns per-change records plus p50/p95 summaries of the two latency
+    distributions: ``sync`` (change → first sync, the pipeline's *noticing*
+    delay, bounded by scrape+rule+sync intervals) and ``scale`` (change →
+    scale event, the full acting delay; None-filtered when a change caused
+    no scale, e.g. a step inside the tolerance band)."""
+    changes = sorted(
+        (s for s in spans if s.kind == "workload_change"),
+        key=lambda s: (s.start, s.span_id),
+    )
+    syncs = sorted(
+        (s for s in spans if s.kind == "hpa_sync"),
+        key=lambda s: (s.start, s.span_id),
+    )
+    scales = sorted(
+        (s for s in spans if s.kind == "scale_event"),
+        key=lambda s: (s.start, s.span_id),
+    )
+    records = []
+    for i, change in enumerate(changes):
+        cutoff = changes[i + 1].start if i + 1 < len(changes) else float("inf")
+        first_sync = next(
+            (s for s in syncs if change.start < s.start <= cutoff), None
+        )
+        first_scale = next(
+            (s for s in scales if change.start < s.start <= cutoff), None
+        )
+        records.append(
+            {
+                "change_ts": change.start,
+                "intensity": change.attrs.get("intensity"),
+                "first_sync_ts": None if first_sync is None else first_sync.start,
+                "scale_ts": None if first_scale is None else first_scale.start,
+                "sync_latency": (
+                    None if first_sync is None else first_sync.start - change.start
+                ),
+                "scale_latency": (
+                    None if first_scale is None else first_scale.start - change.start
+                ),
+            }
+        )
+    sync_lat = [r["sync_latency"] for r in records if r["sync_latency"] is not None]
+    scale_lat = [r["scale_latency"] for r in records if r["scale_latency"] is not None]
+    return {
+        "changes": records,
+        "sync_latency_p50": percentile(sync_lat, 50),
+        "sync_latency_p95": percentile(sync_lat, 95),
+        "scale_latency_p50": percentile(scale_lat, 50),
+        "scale_latency_p95": percentile(scale_lat, 95),
+        "changes_total": len(records),
+        "changes_scaled": len(scale_lat),
+    }

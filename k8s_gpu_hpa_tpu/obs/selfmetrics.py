@@ -1,0 +1,119 @@
+"""Pipeline self-metrics: the control loop meta-monitoring itself.
+
+kube-controller-manager and controller-runtime export metrics about their
+own reconcile loops; the reference stack has nothing of the kind (its
+Grafana deploys unconfigured, SURVEY.md §5).  :class:`PipelineSelfMetrics`
+is that layer for this pipeline: every stage reports into it, and
+``exposition()`` renders the four families below in Prometheus text format
+— served as one more scrape target (``pipeline-self``) alongside the
+workload metrics, so the self-metrics land in the same TSDB, the same
+dashboard (tools/gen_grafana_dashboard.py), and the same doctor probes
+(doctor.check_self_metrics).
+
+Metric names are single-sourced here: the Grafana generator, the doctor
+probe, and the manifest contract test all import these constants, so a
+rename cannot silently orphan a panel or a probe.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+
+#: wall-clock duration of the last HPA sync pass (gauge)
+HPA_SYNC_DURATION = "hpa_sync_duration_seconds"
+#: duration of the last scrape per target (gauge; virtual duration when the
+#: target models one via TimedExposition, wall-clock otherwise)
+SCRAPE_DURATION = "scrape_duration_seconds"
+#: age of the newest input point each recording rule read at its last
+#: evaluation (gauge) — how stale the data behind the autoscale signal is
+RULE_EVAL_STALENESS = "rule_eval_staleness_seconds"
+#: HPA sync decisions by outcome (counter)
+HPA_DECISION_TOTAL = "hpa_decision_total"
+
+SELF_METRIC_NAMES = (
+    HPA_SYNC_DURATION,
+    SCRAPE_DURATION,
+    RULE_EVAL_STALENESS,
+    HPA_DECISION_TOTAL,
+)
+
+#: the scrape-target name the pipeline serves its own metrics under
+SELF_TARGET_NAME = "pipeline-self"
+
+#: every value the ``reason`` label of HPA_DECISION_TOTAL can take
+DECISION_REASONS = (
+    "scale_up",
+    "scale_down",
+    "within_tolerance",
+    "metrics_unavailable",
+    "repair_partial_slice",
+)
+
+
+def decision_reason_label(last_reason: str) -> str:
+    """Collapse an HPAStatus.last_reason string to its counter label —
+    keyed on the fixed prefixes sync_once writes (control/hpa.py)."""
+    if last_reason.startswith("scale up"):
+        return "scale_up"
+    if last_reason.startswith("scale down"):
+        return "scale_down"
+    if last_reason.startswith("repair partial slice"):
+        return "repair_partial_slice"
+    if last_reason.startswith("metrics unavailable"):
+        return "metrics_unavailable"
+    return "within_tolerance"
+
+
+class PipelineSelfMetrics:
+    """Accumulates stage reports; renders them as exposition text."""
+
+    def __init__(self):
+        self.sync_durations: list[float] = []  # every sync, for percentiles
+        self._scrape_duration: dict[str, float] = {}
+        self._rule_staleness: dict[str, float] = {}
+        self.decisions: Counter = Counter()
+
+    # ---- stage report hooks ------------------------------------------------
+
+    def observe_sync(self, duration: float, last_reason: str) -> None:
+        self.sync_durations.append(duration)
+        self.decisions[decision_reason_label(last_reason)] += 1
+
+    def observe_scrape(self, target: str, duration: float) -> None:
+        self._scrape_duration[target] = duration
+
+    def observe_rule_eval(self, rule: str, staleness: float) -> None:
+        self._rule_staleness[rule] = staleness
+
+    # ---- exposition --------------------------------------------------------
+
+    def exposition(self) -> str:
+        """The ``pipeline-self`` target's /metrics body."""
+        sync = MetricFamily(
+            HPA_SYNC_DURATION, "gauge", "wall-clock duration of the last HPA sync"
+        )
+        if self.sync_durations:
+            sync.add(self.sync_durations[-1])
+        scrape = MetricFamily(
+            SCRAPE_DURATION, "gauge", "duration of the last scrape per target"
+        )
+        for target, duration in sorted(self._scrape_duration.items()):
+            scrape.add(duration, target=target)
+        staleness = MetricFamily(
+            RULE_EVAL_STALENESS,
+            "gauge",
+            "age of the newest input point at each rule's last evaluation",
+        )
+        for rule, age in sorted(self._rule_staleness.items()):
+            if not math.isnan(age):
+                staleness.add(age, rule=rule)
+        decisions = MetricFamily(
+            HPA_DECISION_TOTAL, "counter", "HPA sync decisions by outcome"
+        )
+        for reason, count in sorted(self.decisions.items()):
+            decisions.add(float(count), reason=reason)
+        return encode_text([sync, scrape, staleness, decisions])

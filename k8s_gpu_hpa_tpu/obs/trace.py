@@ -1,0 +1,168 @@
+"""Structured trace subsystem on the pipeline's (virtual) clock.
+
+Every pipeline stage emits :class:`Span` records into one :class:`Tracer`;
+spans are causally linked by id (see obs/schema.py for the kind table and
+the allowed link edges), so a scale event can be walked back to the raw
+exporter sweeps that fed it (obs/lineage.py).  Under ``VirtualClock`` the
+whole trace is deterministic: same scenario, same spans, same ids.
+
+Two emission shapes:
+
+- ``emit(kind, attrs, links=...)`` — instantaneous span (most stages: in
+  virtual time a synchronous callback takes zero clock time);
+- ``open(kind)`` … ``close(span, links=..., **attrs)`` — when the span id
+  must exist *before* its attributes do, e.g. the scraper stamps the open
+  span's id as the ``origin`` of every point it appends, then closes the
+  span with the sample count.
+
+Scopes give the HPA sync its children without threading state through the
+adapter: ``push_scope()`` starts collecting the ids of spans closed while
+the scope is active, ``pop_scope()`` returns them — the sync span links to
+exactly the adapter queries its own body issued.
+
+JSONL round-trip (``write_jsonl``/``read_jsonl``) is the offline-analysis
+export behind ``python -m k8s_gpu_hpa_tpu.simulate trace``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from k8s_gpu_hpa_tpu.obs.schema import validate_span_fields
+from k8s_gpu_hpa_tpu.utils.clock import Clock
+
+
+@dataclass
+class Span:
+    """One traced unit of pipeline work.  ``start``/``end`` are clock
+    seconds (virtual in sims); ``links`` are the ids of the spans whose
+    data fed this one (causal parents, not children)."""
+
+    span_id: int
+    kind: str
+    start: float
+    end: float
+    attrs: dict = field(default_factory=dict)
+    links: tuple[int, ...] = ()
+
+    def as_dict(self) -> dict:
+        return {
+            "span_id": self.span_id,
+            "kind": self.kind,
+            "start": self.start,
+            "end": self.end,
+            "attrs": self.attrs,
+            "links": list(self.links),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        return cls(
+            span_id=int(d["span_id"]),
+            kind=d["kind"],
+            start=float(d["start"]),
+            end=float(d["end"]),
+            attrs=dict(d.get("attrs", {})),
+            links=tuple(int(x) for x in d.get("links", [])),
+        )
+
+
+class Tracer:
+    """Collects spans against one clock; every pipeline stage holds (at
+    most) a reference to one of these.  ``validate=True`` checks each span
+    against SPAN_SCHEMA at close time — a stage emitting an undeclared
+    shape fails loudly in tests instead of producing a trace the walker
+    silently cannot follow."""
+
+    def __init__(self, clock: Clock, validate: bool = True):
+        self.clock = clock
+        self.validate = validate
+        self.spans: list[Span] = []
+        self._by_id: dict[int, Span] = {}
+        self._ids = itertools.count(1)
+        self._scopes: list[list[int]] = []
+
+    # ---- emission ----------------------------------------------------------
+
+    def open(
+        self, kind: str, attrs: dict | None = None, start: float | None = None
+    ) -> Span:
+        """Register a span now so its id can be used (as a point origin, as
+        a link target) before its final attributes are known.  The span is
+        not in ``spans`` or any scope until ``close``."""
+        now = self.clock.now()
+        return Span(
+            span_id=next(self._ids),
+            kind=kind,
+            start=now if start is None else start,
+            end=now,
+            attrs=dict(attrs or {}),
+        )
+
+    def close(
+        self,
+        span: Span,
+        links: tuple[int, ...] = (),
+        end: float | None = None,
+        **attrs,
+    ) -> Span:
+        span.end = self.clock.now() if end is None else end
+        span.attrs.update(attrs)
+        span.links = tuple(dict.fromkeys(itertools.chain(span.links, links)))
+        if self.validate:
+            validate_span_fields(span.kind, span.attrs, span_id=span.span_id)
+        self.spans.append(span)
+        self._by_id[span.span_id] = span
+        for scope in self._scopes:
+            scope.append(span.span_id)
+        return span
+
+    def emit(
+        self,
+        kind: str,
+        attrs: dict | None = None,
+        links: tuple[int, ...] = (),
+        start: float | None = None,
+        end: float | None = None,
+    ) -> Span:
+        """One-shot span: open and close in one call."""
+        return self.close(self.open(kind, attrs, start=start), links, end=end)
+
+    # ---- scopes ------------------------------------------------------------
+
+    def push_scope(self) -> None:
+        self._scopes.append([])
+
+    def pop_scope(self) -> tuple[int, ...]:
+        """Ids of every span closed while the innermost scope was active."""
+        return tuple(self._scopes.pop())
+
+    # ---- queries -----------------------------------------------------------
+
+    def get(self, span_id: int) -> Span | None:
+        return self._by_id.get(span_id)
+
+    def spans_of(self, kind: str) -> list[Span]:
+        return [s for s in self.spans if s.kind == kind]
+
+    # ---- JSONL export ------------------------------------------------------
+
+    def write_jsonl(self, path: str | Path) -> int:
+        """One span per line, in emission order; returns the span count."""
+        path = Path(path)
+        with path.open("w") as f:
+            for span in self.spans:
+                f.write(json.dumps(span.as_dict()) + "\n")
+        return len(self.spans)
+
+
+def read_jsonl(path: str | Path) -> list[Span]:
+    """Load a trace export back into Span objects (blank lines skipped)."""
+    spans = []
+    for line in Path(path).read_text().splitlines():
+        if line.strip():
+            spans.append(Span.from_dict(json.loads(line)))
+    return spans

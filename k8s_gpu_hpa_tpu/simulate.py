@@ -77,6 +77,11 @@ class SimReport:
     offered_units: str = "%"  # "%" of one chip, or "req" for queue depth
     #: reachability verdict when a measured signal ceiling was supplied
     target_note: str | None = None
+    #: the obs.Tracer that recorded the run (``trace=True``), else None
+    tracer: object | None = None
+    #: scenario-relative clock offset: span timestamps minus this value are
+    #: on the timeline's t axis (the 15 s settle precedes the scenario)
+    trace_base: float = 0.0
 
 
 def run_scenario(
@@ -86,6 +91,7 @@ def run_scenario(
     pod_start_latency: float = 12.0,
     sample_every: float = 5.0,
     saturated_pct: float | None = None,
+    trace: bool = False,
 ) -> SimReport:
     """Simulate one shipped Object-metric HPA manifest under a load scenario.
 
@@ -113,6 +119,11 @@ def run_scenario(
     quantum = quantum_from_manifest(hpa_doc)
 
     clock = VirtualClock()
+    tracer = None
+    if trace:
+        from k8s_gpu_hpa_tpu.obs import Tracer
+
+        tracer = Tracer(clock)
     max_replicas = spec["maxReplicas"]
     cluster = SimCluster(
         clock,
@@ -134,6 +145,12 @@ def run_scenario(
     # agree (the 15 s settle above is not part of the scenario)
     base = clock.now()
     dep.load_fn = lambda t: load_fn(t - base)
+    if tracer is not None:
+        # intensity steps emit workload_change spans — the start pins of
+        # every signal-propagation measurement (obs/latency.py)
+        from k8s_gpu_hpa_tpu.obs import TracedLoad
+
+        dep.load_fn = TracedLoad(dep.load_fn, tracer)
 
     pipe = AutoscalingPipeline(
         cluster,
@@ -145,6 +162,7 @@ def run_scenario(
         behavior=behavior_from_manifest(hpa_doc),
         replica_quantum=quantum,
         object_kind=ref["kind"],
+        tracer=tracer,
     )
     pipe.start()
 
@@ -152,7 +170,7 @@ def run_scenario(
     crash_at: float | None = 120.0 if scenario == "crash" else None
     originals: list[tuple] = []
 
-    report = SimReport(scenario=scenario)
+    report = SimReport(scenario=scenario, tracer=tracer, trace_base=base)
     t_cross = None
     target_value = metrics[0].target_value
     if saturated_pct is not None:
@@ -289,6 +307,58 @@ def render_report(report: SimReport) -> str:
     return "\n".join(lines)
 
 
+def render_trace_timeline(report: SimReport) -> str:
+    """Causally-ordered decision timeline from a traced run (``trace=True``):
+    offered-load changes, every HPA sync decision, and each scale event
+    annotated with its full metric lineage back to the raw exporter sweeps —
+    the "explain this scale event" view (README runbook)."""
+    from k8s_gpu_hpa_tpu.obs import format_lineage, index_spans, lineage_of
+
+    tracer = report.tracer
+    base = report.trace_base
+    by_id = index_spans(tracer.spans)
+    rows = sorted(
+        (
+            s
+            for s in tracer.spans
+            if s.kind in ("workload_change", "hpa_sync", "scale_event", "fault_window")
+        ),
+        key=lambda s: (s.start, s.span_id),
+    )
+    lines = ["decision timeline (t = seconds since scenario start):"]
+    for s in rows:
+        t = s.start - base
+        if s.kind == "workload_change":
+            prev = s.attrs.get("previous")
+            prev_txt = f"{prev:g}" if prev is not None else "?"
+            desc = f"offered load {prev_txt} -> {s.attrs['intensity']:g}"
+        elif s.kind == "hpa_sync":
+            desc = (
+                f"{s.attrs['reason']} (replicas {s.attrs['current_replicas']}, "
+                f"desired {s.attrs['desired_replicas']})"
+            )
+        elif s.kind == "fault_window":
+            desc = f"{s.attrs['fault']} ({s.attrs['kind']})"
+        else:
+            desc = f"replicas {s.attrs['from_replicas']} -> {s.attrs['to_replicas']}"
+        lines.append(f"t={t:>5.0f}s  {s.kind:<16} #{s.span_id:<5} {desc}")
+        if s.kind == "scale_event":
+            lin = lineage_of(s, by_id)
+            shifted = dict(
+                lin,
+                hops=[
+                    dict(
+                        h,
+                        first_ts=h["first_ts"] - base,
+                        last_ts=h["last_ts"] - base,
+                    )
+                    for h in lin["hops"]
+                ],
+            )
+            lines.append(f"{'':9}lineage: {format_lineage(shifted)}")
+    return "\n".join(lines)
+
+
 def main(args) -> int:
     from pathlib import Path
 
@@ -307,6 +377,60 @@ def main(args) -> int:
             and result["spurious_scale_events_during_blackout"] == 0
         )
         return 0 if ok else 2
+
+    if args.scenario == "trace":
+        # the spike scenario, fully traced: decision timeline with per-scale-
+        # event metric lineage, propagation-latency summary, JSONL export.
+        # Exits non-zero when any scale event cannot be walked back to raw
+        # exporter samples — the observability contract, machine-checked.
+        from k8s_gpu_hpa_tpu.obs import index_spans, lineage_of, propagation_report
+
+        hpa_doc = yaml.safe_load(Path(args.hpa).read_text())
+        report = run_scenario(
+            hpa_doc,
+            scenario="spike",
+            duration=args.duration,
+            pod_start_latency=args.pod_start,
+            trace=True,
+        )
+        print(render_trace_timeline(report))
+        tracer = report.tracer
+        prop = propagation_report(tracer.spans)
+        print()
+        if prop["changes_total"]:
+            def fmt(v):
+                return "-" if v is None else f"{v:.0f}s"
+
+            print(
+                "signal propagation: "
+                f"change -> first sync p50={fmt(prop['sync_latency_p50'])} "
+                f"p95={fmt(prop['sync_latency_p95'])}; "
+                f"change -> scale event p50={fmt(prop['scale_latency_p50'])} "
+                f"p95={fmt(prop['scale_latency_p95'])} "
+                f"({prop['changes_scaled']}/{prop['changes_total']} changes scaled)"
+            )
+        out = getattr(args, "trace_out", None) or "trace.jsonl"
+        n = tracer.write_jsonl(out)
+        print(f"wrote {n} spans to {out}")
+        by_id = index_spans(tracer.spans)
+        events = tracer.spans_of("scale_event")
+        incomplete = [
+            ev.span_id
+            for ev in events
+            if not lineage_of(ev, by_id)["complete"]
+        ]
+        if not events or incomplete:
+            print(
+                "TRACE CONTRACT VIOLATED: "
+                + (
+                    f"scale events {incomplete} have no lineage back to "
+                    "exporter samples"
+                    if incomplete
+                    else "no scale events traced"
+                )
+            )
+            return 2
+        return 0
 
     hpa_doc = yaml.safe_load(Path(args.hpa).read_text())
     metrics = metrics_from_manifest(hpa_doc)
@@ -358,10 +482,15 @@ if __name__ == "__main__":
         "scenario",
         nargs="?",
         default="spike",
-        choices=["spike", "ramp", "flap", "outage", "crash", "chaos"],
+        choices=["spike", "ramp", "flap", "outage", "crash", "chaos", "trace"],
     )
     parser.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
     parser.add_argument("--duration", type=float, default=420.0)
     parser.add_argument("--pod-start", type=float, default=12.0)
     parser.add_argument("--saturated-pct", type=float, default=None)
+    parser.add_argument(
+        "--trace-out",
+        default="trace.jsonl",
+        help="JSONL span export path for the 'trace' scenario",
+    )
     sys.exit(main(parser.parse_args()))

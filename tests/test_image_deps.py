@@ -188,10 +188,34 @@ _UNIQUE: dict[tuple[str, str, str], tuple[str, str, str, dict]] = {}
 for _m, _img, _mod, _env in _COMMANDS:
     _UNIQUE.setdefault((_img, _mod, _env.get("WORKLOAD", "")), (_m, _img, _mod, _env))
 
+# Some environments carry a numpy whose distribution resolves
+# (metadata.distribution works) but whose import root never appears in
+# packages_distributions() — numpy then stays out of the allowed-roots set,
+# the sandbox blocks `import numpy`, and jax's ml_dtypes C extension dies
+# with "numpy._core.umath failed to import".  That is a metadata gap in the
+# TEST environment, not a Dockerfile gap, and it only bites the jax-importing
+# loadgen entrypoints (the exporter chain never imports numpy at module
+# level) — so the guard is attached per-case, not module-wide.
+_NUMPY_ROOTS_BROKEN = (
+    metadata.packages_distributions().get("numpy") is None and _installed("numpy")
+)
+_NUMPY_GUARD = pytest.mark.skipif(
+    _NUMPY_ROOTS_BROKEN,
+    reason="numpy installed but absent from packages_distributions(): the "
+    "import sandbox would block numpy and fail jax/ml_dtypes for a test-env "
+    "metadata gap, not a missing image dependency",
+)
+
 
 @pytest.mark.parametrize(
     "manifest,image,module,env",
-    list(_UNIQUE.values()),
+    [
+        pytest.param(
+            *case,
+            marks=(_NUMPY_GUARD,) if case[2].startswith("k8s_gpu_hpa_tpu.loadgen") else (),
+        )
+        for case in _UNIQUE.values()
+    ],
     ids=[f"{m}:{mod}" for m, _, mod, _ in _UNIQUE.values()],
 )
 def test_manifest_command_importable_with_image_deps(manifest, image, module, env):

@@ -181,6 +181,7 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
     dash = json.loads(doc["data"]["tpu-hpa-pipeline.json"])
 
     from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
+    from k8s_gpu_hpa_tpu.obs.selfmetrics import SELF_METRIC_NAMES
 
     rule_doc = load("tpu-test-prometheusrule.yaml")
     recorded = {
@@ -213,6 +214,9 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
             "quantum_operator_reconciles_total",
             "quantum_operator_lease_transitions_total",
         }
+        # pipeline self-metrics (obs/selfmetrics.py, the pipeline-self
+        # scrape target) — single-sourced so a rename breaks this test
+        | set(SELF_METRIC_NAMES)
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
     assert exprs, "dashboard has no queries"
@@ -220,7 +224,10 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         names = {
             tok
             for tok in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr)
-            if tok.startswith(("tpu_", "kube_", "ALERTS", "quantum_operator_"))
+            if tok.startswith(
+                ("tpu_", "kube_", "ALERTS", "quantum_operator_")
+            )
+            or tok in SELF_METRIC_NAMES
         }
         assert names, f"no metric reference in {expr!r}"
         assert names <= known, f"unknown series in {expr!r}: {names - known}"

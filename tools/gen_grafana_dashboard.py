@@ -29,6 +29,12 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
+from k8s_gpu_hpa_tpu.obs.selfmetrics import (  # noqa: E402
+    HPA_DECISION_TOTAL,
+    HPA_SYNC_DURATION,
+    RULE_EVAL_STALENESS,
+    SCRAPE_DURATION,
+)
 
 HPA_TARGET_PERCENT = 40  # deploy/tpu-test-hpa.yaml target value
 HBM_TARGET_BYTES = 13 * 2**30  # deploy/tpu-test-hbm-hpa.yaml averageValue 13Gi
@@ -359,6 +365,75 @@ def build_dashboard() -> dict:
             "page (inert pairing — the workload cannot reach its own "
             "target).",
             threshold=SERVE_BW_TARGET,
+        ),
+        # ---- pipeline self-metrics (obs/selfmetrics.py): the control loop
+        # monitoring itself, served by the pipeline-self scrape target ----
+        _ts_panel(
+            12,
+            "Pipeline self: HPA sync duration",
+            0,
+            48,
+            [_target(HPA_SYNC_DURATION, "sync duration", "A")],
+            "Wall-clock cost of each HPA sync pass (metric fetch + decision "
+            "+ scale patch).  A growing trend means the adapter or the "
+            "apiserver is slowing the loop down.",
+            unit="s",
+            legend=False,
+        ),
+        _ts_panel(
+            13,
+            "Pipeline self: scrape duration per target",
+            12,
+            48,
+            [
+                _target(
+                    f"max by(target) ({SCRAPE_DURATION})",
+                    "{{target}}",
+                    "A",
+                )
+            ],
+            "How long each scrape target took to answer on its last scrape.  "
+            "One target drifting up while the rest hold is that exporter "
+            "degrading before it goes down outright.",
+            unit="s",
+        ),
+        _ts_panel(
+            14,
+            "Pipeline self: HPA decisions by reason",
+            0,
+            56,
+            [
+                _target(
+                    f"sum by(reason) (increase({HPA_DECISION_TOTAL}[5m]))",
+                    "{{reason}}",
+                    "A",
+                )
+            ],
+            "Sync outcomes per 5m.  Steady within_tolerance is the healthy "
+            "idle; sustained metrics_unavailable is a blind controller "
+            "(doctor's L3/L4 probes say which joint); alternating scale_up/"
+            "scale_down is thrash the behavior stanza should be damping.",
+        ),
+        _ts_panel(
+            15,
+            "Pipeline self: signal propagation lag (rule-eval staleness)",
+            12,
+            56,
+            [
+                _target(
+                    f"max by(rule) ({RULE_EVAL_STALENESS})",
+                    "{{rule}}",
+                    "A",
+                )
+            ],
+            "Age of the newest input point each recording rule read at its "
+            "last evaluation — the upstream half of signal-propagation "
+            "latency (bench rung signal_latency measures the end-to-end "
+            "half).  The red line marks the exporter staleness window (10s): "
+            "above it the HPA is deciding on data older than the pipeline's "
+            "own freshness contract.",
+            unit="s",
+            threshold=10,
         ),
     ]
     return {

@@ -17,6 +17,17 @@ KEEP=${1:-}
 
 say() { printf '\n== %s\n' "$*"; }
 
+say "0/10 trace smoke (decision timeline + lineage, no cluster needed)"
+# the same pipeline code the cluster steps exercise, run traced in virtual
+# time: must produce a causally-complete decision timeline and a JSONL
+# export that passes the span-schema lint before we spend minutes on kind
+TRACE_OUT=$(mktemp /tmp/kind-e2e-trace.XXXXXX.jsonl)
+python -m k8s_gpu_hpa_tpu simulate --scenario trace --trace-out "$TRACE_OUT" \
+  || { echo "FAIL: simulate trace reported an incomplete decision lineage"; exit 1; }
+python tools/lint_trace_schema.py "$TRACE_OUT" \
+  || { echo "FAIL: trace export violates the span schema"; exit 1; }
+rm -f "$TRACE_OUT"
+
 say "1/10 kind cluster"
 kind get clusters 2>/dev/null | grep -qx "$CLUSTER" || kind create cluster --name "$CLUSTER" --wait 120s
 kubectl config use-context "kind-$CLUSTER"

@@ -1,0 +1,150 @@
+"""Lint a trace JSONL export against the span schema (obs/schema.py).
+
+The Tracer validates each span's attrs at close time, but a JSONL file on
+disk has left that process: it may come from an older build, a partial
+write, or hand editing.  This lint re-validates a whole export offline —
+the trace-side analog of ``gen-manifests --check`` — so every consumer
+(the lineage walker, the timeline renderer, external tooling) can trust
+any file that passes:
+
+- every span's kind/attrs match SPAN_SCHEMA (required present, nothing
+  undeclared);
+- span ids are unique and every link resolves to a span IN THE FILE whose
+  kind the schema allows for that edge (no dangling or cross-layer links);
+- no span ends before it starts, and no span links to itself.
+
+Usage:
+    python tools/lint_trace_schema.py TRACE.jsonl [TRACE2.jsonl ...]
+    python tools/lint_trace_schema.py --selfcheck
+
+``--selfcheck`` runs a short traced simulation in-process, exports it, and
+lints the result — the zero-fixture mode tools/tier1.sh runs so the real
+emitters are checked against the schema on every verify pass.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_gpu_hpa_tpu.obs import SPAN_SCHEMA, Span, read_jsonl  # noqa: E402
+from k8s_gpu_hpa_tpu.obs.schema import validate_span_fields  # noqa: E402
+
+
+def lint_spans(spans: list[Span]) -> list[str]:
+    """Every schema violation in ``spans``, as human-readable strings."""
+    errors: list[str] = []
+    by_id: dict[int, Span] = {}
+    for span in spans:
+        if span.span_id in by_id:
+            errors.append(f"span {span.span_id}: duplicate span id")
+        by_id[span.span_id] = span
+    for span in spans:
+        try:
+            validate_span_fields(span.kind, span.attrs, span_id=span.span_id)
+        except ValueError as e:
+            errors.append(str(e))
+            continue
+        if span.end < span.start:
+            errors.append(
+                f"span {span.span_id} ({span.kind}): end {span.end} before "
+                f"start {span.start}"
+            )
+        allowed = SPAN_SCHEMA[span.kind]["link_kinds"]
+        for link in span.links:
+            if link == span.span_id:
+                errors.append(f"span {span.span_id} ({span.kind}): links to itself")
+                continue
+            target = by_id.get(link)
+            if target is None:
+                errors.append(
+                    f"span {span.span_id} ({span.kind}): link {link} not in file"
+                )
+            elif target.kind not in allowed:
+                errors.append(
+                    f"span {span.span_id} ({span.kind}): link {link} is a "
+                    f"{target.kind!r} span, schema allows {sorted(allowed)}"
+                )
+    return errors
+
+
+def lint_file(path: str | Path) -> list[str]:
+    try:
+        spans = read_jsonl(path)
+    except Exception as e:  # unreadable line IS a lint finding
+        return [f"{path}: unparseable JSONL ({e})"]
+    if not spans:
+        return [f"{path}: no spans"]
+    return lint_spans(spans)
+
+
+def _selfcheck() -> int:
+    """Run a short traced sim with a scale-provoking load step, export it,
+    and lint the export — proving the live emitters still speak the schema."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+    from k8s_gpu_hpa_tpu.obs import Tracer
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    clock = VirtualClock()
+    tracer = Tracer(clock)
+    cluster = SimCluster(clock, nodes=[("lint-node-0", 4), ("lint-node-1", 4)])
+    dep = SimDeployment(
+        cluster,
+        "tpu-test",
+        "tpu-test",
+        load_fn=lambda t: 30.0 if t < 60.0 else 95.0,
+        load_mode="shared",
+    )
+    cluster.add_deployment(dep, replicas=1)
+    pipe = AutoscalingPipeline(
+        cluster, dep, target_value=40.0, max_replicas=4, tracer=tracer
+    )
+    pipe.start()
+    clock.advance(150.0)
+    if not tracer.spans_of("scale_event"):
+        print("selfcheck: the scenario produced no scale_event span")
+        return 1
+    with tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False) as f:
+        path = Path(f.name)
+    try:
+        tracer.write_jsonl(path)
+        errors = lint_file(path)
+    finally:
+        path.unlink(missing_ok=True)
+    for err in errors:
+        print(f"selfcheck: {err}")
+    if errors:
+        return 1
+    kinds = sorted({s.kind for s in tracer.spans})
+    print(
+        f"selfcheck ok: {len(tracer.spans)} spans "
+        f"({', '.join(kinds)}) all match the schema"
+    )
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print(__doc__.split("Usage:")[1].strip(), file=sys.stderr)
+        return 2
+    if argv == ["--selfcheck"]:
+        return _selfcheck()
+    rc = 0
+    for arg in argv:
+        errors = lint_file(arg)
+        if errors:
+            rc = 1
+            for err in errors:
+                print(f"{arg}: {err}")
+        else:
+            spans = read_jsonl(arg)
+            print(f"{arg}: {len(spans)} spans ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
