@@ -29,7 +29,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable
 
-from k8s_gpu_hpa_tpu.metrics.tsdb import ScrapeTarget, TimedExposition
+from k8s_gpu_hpa_tpu.metrics.tsdb import (
+    ScrapeTarget,
+    StructuredExposition,
+    TimedExposition,
+)
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
@@ -109,6 +113,10 @@ def _inject_frozen_samples(pipe: "AutoscalingPipeline", spec: FaultSpec) -> Clea
         frozen = original()
         if isinstance(frozen, TimedExposition):
             frozen = frozen.text
+        elif isinstance(frozen, StructuredExposition):
+            frozen = frozen.families
+        # a captured list[MetricFamily] freezes just as well as text: the
+        # exporter replaces (never mutates) its cached families per sweep
         return lambda: frozen
 
     return _wrap_fetch(_scrape_targets(pipe, spec.target), make_fetch)
@@ -120,8 +128,13 @@ def _inject_slow_scrape(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn
 
         def slow():
             fetched = original()
-            text = fetched.text if isinstance(fetched, TimedExposition) else fetched
-            return TimedExposition(text, duration=latency)
+            if isinstance(fetched, TimedExposition):
+                return TimedExposition(fetched.text, duration=latency)
+            if isinstance(fetched, StructuredExposition):
+                return StructuredExposition(fetched.families, duration=latency)
+            if isinstance(fetched, str):
+                return TimedExposition(fetched, duration=latency)
+            return StructuredExposition(fetched, duration=latency)
 
         return slow
 

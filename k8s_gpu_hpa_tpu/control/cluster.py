@@ -162,26 +162,42 @@ class _NodeExporter:
         self.cluster = cluster
         self.node = node
         self.sample_interval = sample_interval
-        self._cache: str | None = None
+        #: the collected families are the cache; text is rendered lazily and
+        #: memoized per sweep, so structured scrapes never pay the encode
+        self._families: list[MetricFamily] | None = None
+        self._text: str | None = None
         self._last_sample = -float("inf")
         #: span id of the collection sweep behind the current cache — the
         #: lineage root a scrape of this exporter links to (a cache hit
         #: correctly keeps the OLD sweep's id: the data really is that old)
         self.last_span_id: int | None = None
 
-    def fetch(self) -> str:
+    def _refresh(self) -> None:
         now = self.cluster.clock.now()
-        if self._cache is None or now - self._last_sample >= self.sample_interval:
-            self._cache = self._collect()
+        if self._families is None or now - self._last_sample >= self.sample_interval:
+            self._families = self._collect()
+            self._text = None
             self._last_sample = now
             if self.cluster.tracer is not None:
                 self.last_span_id = self.cluster.tracer.emit(
                     "exporter_sample",
                     {"node": self.node.name, "chips": self.node.num_chips},
                 ).span_id
-        return self._cache
 
-    def _collect(self) -> str:
+    def fetch(self) -> str:
+        self._refresh()
+        if self._text is None:
+            self._text = encode_text(self._families)
+        return self._text
+
+    def fetch_families(self) -> list[MetricFamily]:
+        """Structured fast path: the same cached sweep, no text round trip.
+        Cache-hit semantics (and the lineage span id) are identical to
+        ``fetch`` — only the serialization is skipped."""
+        self._refresh()
+        return self._families
+
+    def _collect(self) -> list[MetricFamily]:
         chips: list[ChipSample] = []
         attribution: dict[int, tuple[str, str]] = {}
         for idx in range(self.node.num_chips):
@@ -204,8 +220,8 @@ class _NodeExporter:
                     hbm_bw_util=util * 0.6,
                 )
             )
-        return encode_text(
-            families_from_chips(chips, node=self.node.name, attribution=attribution)
+        return families_from_chips(
+            chips, node=self.node.name, attribution=attribution
         )
 
 
@@ -386,12 +402,19 @@ class SimCluster:
             raise ConnectionError(f"node {node_name} is down (preempted)")
         return self.exporters[node_name].fetch()
 
+    def exporter_fetch_families(self, node_name: str) -> list[MetricFamily]:
+        """Structured-scrape variant of ``exporter_fetch``: identical data,
+        identical down-node failure, no text round trip."""
+        if not self.nodes[node_name].ready:
+            raise ConnectionError(f"node {node_name} is down (preempted)")
+        return self.exporters[node_name].fetch_families()
+
     def exporter_sample_span(self, node_name: str) -> int | None:
         """Span id of the collection sweep behind the node exporter's current
         cache (ScrapeTarget.trace_origin provider)."""
         return self.exporters[node_name].last_span_id
 
-    def kube_state_metrics_text(self) -> str:
+    def kube_state_metrics_families(self) -> list[MetricFamily]:
         """``kube_pod_labels`` for every pod (kube-state-metrics exports Pending
         pods too; the rule's inner join plus the absent device metric is what
         keeps them out of the average — SURVEY.md §3.2)."""
@@ -403,4 +426,9 @@ class SimCluster:
                 pod=pod.name,
                 label_app=pod.labels.get("app", ""),
             )
-        return encode_text([fam])
+        return [fam]
+
+    def kube_state_metrics_text(self) -> str:
+        """Text-exposition rendering of ``kube_state_metrics_families`` (the
+        conformance path)."""
+        return encode_text(self.kube_state_metrics_families())

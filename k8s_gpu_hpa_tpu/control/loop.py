@@ -64,6 +64,7 @@ class AutoscalingPipeline:
         metric_specs: list[MetricSpec] | None = None,
         extra_adapter_rules: list[AdapterRule] | None = None,
         tracer=None,
+        structured_scrapes: bool = True,
     ):
         self.cluster = cluster
         self.deployment = deployment
@@ -89,9 +90,19 @@ class AutoscalingPipeline:
             tracer=tracer,
             selfmetrics=self.selfmetrics,
         )
+        # Structured scrapes (the default) hand the scraper pre-parsed
+        # MetricFamily lists — identical samples, no text encode/parse round
+        # trip per tick (tests/test_tsdb_scale.py proves equivalence).
+        # structured_scrapes=False keeps the text conformance path end-to-end.
+        if structured_scrapes:
+            exporter_fetch = cluster.exporter_fetch_families
+            ksm_fetch = cluster.kube_state_metrics_families
+        else:
+            exporter_fetch = cluster.exporter_fetch
+            ksm_fetch = cluster.kube_state_metrics_text
         for node_name in cluster.nodes:
             target = self.scraper.add_target(
-                lambda n=node_name: cluster.exporter_fetch(n),
+                lambda n=node_name: exporter_fetch(n),
                 name=f"exporter/{node_name}",
                 node=node_name,
             )
@@ -99,7 +110,7 @@ class AutoscalingPipeline:
                 target.trace_origin = (
                     lambda n=node_name: cluster.exporter_sample_span(n)
                 )
-        self.scraper.add_target(cluster.kube_state_metrics_text, name="kube-state-metrics")
+        self.scraper.add_target(ksm_fetch, name="kube-state-metrics")
         if self.selfmetrics is not None:
             # the pipeline scrapes its own self-metrics like any other target,
             # so they land in the same TSDB / dashboard / doctor probes

@@ -68,6 +68,14 @@ def encode_text(families: list[MetricFamily]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def flatten(families: list[MetricFamily]) -> list[tuple[str, Sample]]:
+    """Flatten families to (name, sample) pairs — the order-insensitive
+    currency for equivalence checks between the text and structured scrape
+    paths (a structured fetch must ingest exactly what its text rendering
+    would after a parse round trip)."""
+    return [(fam.name, sample) for fam in families for sample in fam.samples]
+
+
 def _parse_labels(body: str) -> tuple[tuple[str, str], ...]:
     labels: list[tuple[str, str]] = []
     i = 0

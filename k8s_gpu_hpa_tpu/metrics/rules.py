@@ -27,6 +27,7 @@ truth for both the tested semantics and the shipped manifest.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.schema import Sample, TPU_DUTY_CYCLE, TPU_TENSORCORE_UTIL
@@ -42,6 +43,12 @@ class Expr:
     def promql(self) -> str:
         raise NotImplementedError
 
+    def input_names(self) -> frozenset[str]:
+        """Series names this expression reads — the key set whose TSDB write
+        versions (``TimeSeriesDB.version``) incremental rule evaluation
+        compares between evals to decide whether a re-eval can short-circuit."""
+        raise NotImplementedError
+
 
 @dataclass
 class Select(Expr):
@@ -52,6 +59,9 @@ class Select(Expr):
 
     def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
         return db.instant_vector(self.name, self.matchers, at)
+
+    def input_names(self) -> frozenset[str]:
+        return frozenset((self.name,))
 
     def promql(self) -> str:
         if not self.matchers:
@@ -79,6 +89,9 @@ class MaxBy(Expr):
             if key not in groups or sample.value > groups[key]:
                 groups[key] = sample.value
         return [Sample(v, k) for k, v in groups.items()]
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
 
     def promql(self) -> str:
         return f"max by({','.join(self.keys)})({self.child.promql()})"
@@ -122,6 +135,9 @@ class MulOnGroupLeft(Expr):
             out.append(Sample(sample.value * match.value, tuple(sorted(labels.items()))))
         return out
 
+    def input_names(self) -> frozenset[str]:
+        return self.left.input_names() | self.right.input_names()
+
     def promql(self) -> str:
         gl = ",".join(self.group_left)
         return (
@@ -141,6 +157,9 @@ class Avg(Expr):
         if not vec:
             return []
         return [Sample(sum(s.value for s in vec) / len(vec), ())]
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
 
     def promql(self) -> str:
         return f"avg({self.child.promql()})"
@@ -163,6 +182,9 @@ class Aggregate(Expr):
         fn = {"min": min, "max": max, "sum": sum, "count": len}[self.op]
         return [Sample(float(fn(values)), ())]
 
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
     def promql(self) -> str:
         return f"{self.op}({self.child.promql()})"
 
@@ -180,6 +202,9 @@ class AndOn(Expr):
         if not self.right.evaluate(db, at):
             return []
         return self.left.evaluate(db, at)
+
+    def input_names(self) -> frozenset[str]:
+        return self.left.input_names() | self.right.input_names()
 
     def promql(self) -> str:
         return f"{self.left.promql()} and on() {self.right.promql()}"
@@ -207,6 +232,9 @@ class Cmp(Expr):
         fn = self._OPS[self.op]
         return [s for s in self.child.evaluate(db, at) if fn(s.value, self.threshold)]
 
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
     def promql(self) -> str:
         t = self.threshold
         rendered = str(int(t)) if t == int(t) else repr(t)
@@ -225,6 +253,9 @@ class Absent(Expr):
         if self.child.evaluate(db, at):
             return []
         return [Sample(1.0, ())]
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
 
     def promql(self) -> str:
         return f"absent({self.child.promql()})"
@@ -258,12 +289,56 @@ class AlertRule:
 
 @dataclass
 class RecordingRule:
-    """``record:`` output series name, expression, and static output labels."""
+    """``record:`` output series name, expression, and static output labels.
+
+    Evaluation is **incremental**: every eval records the TSDB write-version
+    signature of its input names, and a re-eval short-circuits when nothing
+    it could read has changed (see ``_can_skip`` for the exact conditions) —
+    on a fleet where most series update slower than the rule interval, most
+    ticks cost a few integer compares instead of a full expression walk."""
 
     record: str
     expr: Expr
     labels: dict[str, str] = field(default_factory=dict)
     _last_keys: set[tuple[tuple[str, str], ...]] = field(default_factory=set, repr=False)
+    #: incremental-eval state: input version signature + timestamp of the
+    #: last full eval, and the age extremes of the points it read
+    _input_names: tuple[str, ...] | None = field(default=None, repr=False)
+    _last_sig: tuple[int, ...] | None = field(default=None, repr=False)
+    _last_eval_ts: float = field(default=-math.inf, repr=False)
+    _last_oldest_read: float | None = field(default=None, repr=False)
+    _last_newest_read: float | None = field(default=None, repr=False)
+    #: eval counters, for harness/bench observability
+    full_evals: int = field(default=0, repr=False)
+    skipped_evals: int = field(default=0, repr=False)
+
+    def _can_skip(self, db: TimeSeriesDB, ts: float, sig: tuple[int, ...]) -> bool:
+        """A skipped eval must be indistinguishable to every consumer reading
+        at ``>= ts``.  Three hazards gate it:
+
+        - **dirty inputs**: any write to any input name (staleness markers
+          included — they bump the version too) can change the result;
+        - **refresh horizon**: a full eval rewrites output points at ``ts``,
+          extending their staleness life; skipping must never let outputs
+          written at the last full eval drift toward the lookback edge, so
+          idling past half the window forces a refreshing re-eval;
+        - **aging inputs**: with zero writes the visible input set can only
+          SHRINK (a point crossing the lookback horizon changes e.g. a max);
+          if the oldest point the last eval read is still inside the window,
+          nothing it used has expired.
+        """
+        if not self._input_names:
+            return False  # expression with undeclared inputs: always re-eval
+        if sig != self._last_sig or ts < self._last_eval_ts:
+            return False
+        if ts - self._last_eval_ts > db.lookback * 0.5:
+            return False
+        if (
+            self._last_oldest_read is not None
+            and ts - self._last_oldest_read > db.lookback
+        ):
+            return False
+        return True
 
     def evaluate_into(
         self,
@@ -283,15 +358,35 @@ class RecordingRule:
         points — the middle hop of metric lineage."""
         count = 0
         ts = db.clock.now() if at is None else at
+        if self._input_names is None:
+            try:
+                self._input_names = tuple(sorted(self.expr.input_names()))
+            except NotImplementedError:
+                self._input_names = ()  # unknown inputs: never short-circuit
+        version = db.version
+        sig = tuple(version(n) for n in self._input_names)
+        if self._can_skip(db, ts, sig):
+            # Short-circuit: a full eval would write byte-identical values.
+            # Consumers keep reading the last full eval's points — same
+            # values, same origins, so metric lineage stays walkable — and
+            # staleness markers already written stand (a vanished output key
+            # can only re-appear via an input write, which forces a re-eval).
+            self.skipped_evals += 1
+            if selfmetrics is not None and self._last_newest_read is not None:
+                selfmetrics.observe_rule_eval(
+                    self.record, ts - self._last_newest_read
+                )
+            return 0
+        self.full_evals += 1
         span = tracer.open("rule_eval", {"rule": self.record}) if tracer else None
         origin = None if span is None else span.span_id
-        capturing = tracer is not None or selfmetrics is not None
-        if capturing:
-            db.begin_capture()
+        # capture is always on for a full eval: the read timestamps feed the
+        # aging guard above (and lineage/self-metrics when wired)
+        db.begin_capture()
         try:
             outputs = self.expr.evaluate(db, at)
         finally:
-            reads = db.end_capture() if capturing else []
+            reads = db.end_capture()
         produced: set[tuple[tuple[str, str], ...]] = set()
         for sample in outputs:
             labels = dict(sample.labels)
@@ -303,7 +398,16 @@ class RecordingRule:
         for key in self._last_keys - produced:
             db.mark_stale(self.record, key, ts, origin=origin)
         self._last_keys = produced
-        staleness = ts - max(r[2] for r in reads) if reads else None
+        self._last_sig = sig
+        self._last_eval_ts = ts
+        if reads:
+            read_ts = [r[2] for r in reads]
+            self._last_oldest_read = min(read_ts)
+            self._last_newest_read = max(read_ts)
+        else:
+            self._last_oldest_read = None
+            self._last_newest_read = None
+        staleness = ts - self._last_newest_read if reads else None
         if selfmetrics is not None and staleness is not None:
             selfmetrics.observe_rule_eval(self.record, staleness)
         if span is not None:

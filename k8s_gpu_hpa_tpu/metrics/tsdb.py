@@ -7,12 +7,25 @@ harness the reference never had (its testing is manual curl probes,
 README.md:42-47,80-88), this module reproduces the two Prometheus behaviors the
 pipeline depends on:
 
-- **scrape**: pull text exposition from targets every interval (reference scrapes
+- **scrape**: pull exposition from targets every interval (reference scrapes
   at 1 s, kube-prometheus-stack-values.yaml:5) and attach target metadata labels —
-  the ``node`` relabel of kube-prometheus-stack-values.yaml:13-16.
+  the ``node`` relabel of kube-prometheus-stack-values.yaml:13-16.  Targets
+  serve either text exposition (the conformance path) or pre-parsed
+  ``MetricFamily`` lists (the structured fast path — same samples, no text
+  round trip; tests/test_tsdb_scale.py proves the two paths ingest
+  identically).
 - **instant query with staleness**: the newest point per series within a lookback
   window (Prometheus default 5 min), which is what both the recording-rule engine
   and the custom-metrics adapter consume.
+
+Fleet-scale internals (ISSUE 3): series keep a bounded retention window
+(trimmed on append, never more than ~2x the window), labels are interned and
+inverted-indexed so matcher queries touch only candidate series, every write
+bumps a per-name version counter (the dirty bit incremental rule evaluation
+watches), and series ended by a staleness marker are garbage-collected once
+the marker ages out of the lookback window.  The read-capture lineage
+chokepoint is untouched: ``instant_vector`` remains the one function every
+read goes through, so capture sees exactly the points any query path returns.
 """
 
 from __future__ import annotations
@@ -20,36 +33,65 @@ from __future__ import annotations
 import math
 import random
 import time
+from bisect import bisect_left, bisect_right
 from dataclasses import dataclass, field
 from typing import Callable
 
 from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
-from k8s_gpu_hpa_tpu.metrics.schema import Sample
+from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily, Sample
 from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 
 LabelSet = tuple[tuple[str, str], ...]
 
 
-@dataclass
 class _Series:
-    labels: LabelSet
-    #: (ts, value, origin) — origin is the span id of the pipeline stage
-    #: that wrote the point (obs/trace.py), or None when untraced
-    points: list[tuple[float, float, int | None]] = field(default_factory=list)
+    """One labeled series: parallel (ts, points) lists, sorted by construction
+    (``TimeSeriesDB.append`` rejects time travel), so reads bisect.
+
+    Retention is enforced on append (inlined in ``TimeSeriesDB.append``, the
+    hottest path at fleet scale): once the dead prefix (points older than
+    ``newest - retention``) outgrows the live suffix it is dropped in one
+    slice — amortized O(1) per append, and the retained list never exceeds
+    ~2x the window.  A staleness marker can only be dropped together with
+    every point BEFORE it (the trim removes a strict prefix), so trimming can
+    never resurrect an ended series: a historical read that would have hit
+    the marker now finds nothing at all, which reads the same (None).
+    """
+
+    __slots__ = ("labels", "points", "ts")
+
+    def __init__(self, labels: LabelSet):
+        self.labels = labels
+        #: (ts, value, origin) — origin is the span id of the pipeline stage
+        #: that wrote the point (obs/trace.py), or None when untraced
+        self.points: list[tuple[float, float, int | None]] = []
+        #: parallel timestamp list, the bisect key (kept separate so the
+        #: search never allocates point tuples)
+        self.ts: list[float] = []
 
     def latest_point_at(
         self, at: float, lookback: float
     ) -> tuple[float, float, int | None] | None:
-        # Points arrive in time order; scan from the end.  A NaN point is a
-        # staleness marker (Prometheus semantics: written when a scrape fails or
-        # a rule's output series disappears) and ends the series immediately.
-        for point in reversed(self.points):
-            ts, value = point[0], point[1]
-            if ts <= at:
-                if math.isnan(value) or at - ts > lookback:
-                    return None
-                return point
-        return None
+        tslist = self.ts
+        if not tslist:
+            return None
+        # Fast path: the common ``at=now`` read lands at/after the newest
+        # point; historical reads (lineage replay, chaos reports) bisect.
+        if at >= tslist[-1]:
+            idx = len(tslist) - 1
+        else:
+            idx = bisect_right(tslist, at) - 1
+            if idx < 0:
+                return None
+        point = self.points[idx]
+        value = point[1]
+        # A NaN point is a staleness marker (Prometheus semantics: written
+        # when a scrape fails or a rule's output series disappears) and ends
+        # the series immediately.  value != value is the allocation-free
+        # math.isnan.
+        if value != value or at - point[0] > lookback:
+            return None
+        return point
 
     def latest_at(self, at: float, lookback: float) -> float | None:
         point = self.latest_point_at(at, lookback)
@@ -57,12 +99,41 @@ class _Series:
 
 
 class TimeSeriesDB:
-    """Append-only store of named series, queried as instant vectors."""
+    """Store of named series with bounded retention, queried as instant vectors."""
 
-    def __init__(self, clock: Clock | None = None, lookback: float = 300.0):
+    #: amortized GC cadence: every this-many appends, sweep series whose
+    #: staleness marker has aged out of the lookback window
+    GC_EVERY = 4096
+
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        lookback: float = 300.0,
+        retention: float | None = None,
+    ):
         self.clock = clock or SystemClock()
         self.lookback = lookback
+        #: per-series retained window; never below lookback (a shorter
+        #: retention would drop points still visible to ``at >= newest``
+        #: queries).  Historical queries older than this see trimmed data.
+        self.retention = lookback if retention is None else max(retention, lookback)
         self._data: dict[str, dict[LabelSet, _Series]] = {}
+        #: inverted label index per name: (key, value) -> ordered set of the
+        #: label sets carrying that pair (dict-as-ordered-set keeps matcher
+        #: query results deterministic run-to-run, unlike a hash set)
+        self._index: dict[str, dict[tuple[str, str], dict[LabelSet, None]]] = {}
+        #: label-set interning pool: every stored series shares one canonical
+        #: tuple object per distinct label set, so dict probes on the hot
+        #: append path win the identity comparison before any tuple compare
+        self._intern: dict[LabelSet, LabelSet] = {}
+        #: per-name monotonic write counters — the dirty bits incremental
+        #: rule evaluation (rules.py) compares between evals
+        self._versions: dict[str, int] = {}
+        #: (name, labels) -> marker ts for series ended by a staleness
+        #: marker; the GC sweep drops them once the marker ages out
+        self._stale_pending: dict[tuple[str, LabelSet], float] = {}
+        self._total_points = 0
+        self._appends_since_gc = 0
         #: active read-capture sink (see begin_capture), else None
         self._capture: list[tuple[str, LabelSet, float, float, int | None]] | None = None
 
@@ -75,8 +146,85 @@ class TimeSeriesDB:
         origin: int | None = None,
     ) -> None:
         ts = self.clock.now() if ts is None else ts
-        series = self._data.setdefault(name, {}).setdefault(labels, _Series(labels))
+        by_name = self._data.get(name)
+        if by_name is None:
+            by_name = self._data[name] = {}
+        series = by_name.get(labels)
+        if series is None:
+            labels = self._intern.setdefault(labels, labels)
+            series = by_name[labels] = _Series(labels)
+            index = self._index.setdefault(name, {})
+            for pair in labels:
+                index.setdefault(pair, {})[labels] = None
+        elif series.ts and ts < series.ts[-1]:
+            # Out-of-order appends would silently break the bisect/scan-from-
+            # end invariant every read relies on; reject loudly.  Equal
+            # timestamps are allowed (a re-write within one tick wins).
+            raise ValueError(
+                f"out-of-order append to {name}{dict(series.labels)}: "
+                f"ts {ts} < newest {series.ts[-1]}"
+            )
+        # Inlined _Series.append_point (this is the hottest statement in a
+        # fleet-scale run; the call overhead alone was measurable): append,
+        # then trim the aged-out prefix once it dominates the list —
+        # amortized O(1), retained length bounded by ~2x the window, and a
+        # strict prefix drop can never resurrect a marker-ended series.
         series.points.append((ts, value, origin))
+        tslist = series.ts
+        tslist.append(ts)
+        dropped = 0
+        if tslist[0] < ts - self.retention:
+            idx = bisect_left(tslist, ts - self.retention)
+            if 2 * idx >= len(tslist):
+                del series.points[:idx]
+                del tslist[:idx]
+                dropped = idx
+        self._total_points += 1 - dropped
+        self._versions[name] = self._versions.get(name, 0) + 1
+        if value != value:  # NaN marker: schedule the ended series for GC
+            self._stale_pending[(name, series.labels)] = ts
+        elif self._stale_pending:
+            # a live point resurrects a marker-ended series: cancel its GC
+            self._stale_pending.pop((name, series.labels), None)
+        self._appends_since_gc += 1
+        if self._appends_since_gc >= self.GC_EVERY:
+            self.gc()
+
+    def gc(self) -> int:
+        """Drop series whose staleness marker has aged out of the lookback
+        window: no ``at >= marker + lookback`` query can distinguish the
+        dropped series from the marker it already could not see past.  Runs
+        amortized from ``append`` (every GC_EVERY writes); callable directly
+        by harnesses.  Returns the number of series dropped."""
+        self._appends_since_gc = 0
+        if not self._stale_pending:
+            return 0
+        now = self.clock.now()
+        dropped = 0
+        for key, marker_ts in list(self._stale_pending.items()):
+            if now - marker_ts <= self.lookback:
+                continue
+            del self._stale_pending[key]
+            name, labels = key
+            by_name = self._data.get(name)
+            series = by_name.pop(labels, None) if by_name is not None else None
+            if series is None:
+                continue
+            self._total_points -= len(series.points)
+            index = self._index.get(name)
+            if index is not None:
+                for pair in labels:
+                    bucket = index.get(pair)
+                    if bucket is not None:
+                        bucket.pop(labels, None)
+                        if not bucket:
+                            del index[pair]
+                if not index:
+                    del self._index[name]
+            if not by_name:
+                del self._data[name]
+            dropped += 1
+        return dropped
 
     # ---- read capture (metric lineage) ------------------------------------
     #
@@ -84,7 +232,7 @@ class TimeSeriesDB:
     # bracketing their reads: every point an instant query returns while a
     # capture is active is recorded with its origin span id.  This keeps
     # lineage out of the expression AST and the adapter's query logic — the
-    # DB is the one chokepoint every read goes through.
+    # DB is the one chokepoint every read goes through, index or not.
 
     def begin_capture(self) -> None:
         self._capture = []
@@ -103,18 +251,54 @@ class TimeSeriesDB:
     ) -> list[Sample]:
         """All series of ``name`` matching label equalities, at their latest value."""
         at = self.clock.now() if at is None else at
+        by_name = self._data.get(name)
+        if not by_name:
+            return []
+        if matchers:
+            # Inverted-index path: intersect the (key, value) buckets instead
+            # of scanning every series of the name.  A matcher with no bucket
+            # can match nothing (equality match requires the label present).
+            index = self._index.get(name, {})
+            buckets: list[dict[LabelSet, None]] = []
+            for pair in matchers.items():
+                bucket = index.get(pair)
+                if not bucket:
+                    return []
+                buckets.append(bucket)
+            buckets.sort(key=len)
+            smallest, rest = buckets[0], buckets[1:]
+            if rest:
+                series_list = [
+                    by_name[ls] for ls in smallest if all(ls in b for b in rest)
+                ]
+            else:
+                series_list = [by_name[ls] for ls in smallest]
+        else:
+            series_list = by_name.values()
+        lookback = self.lookback
+        capture = self._capture
         out: list[Sample] = []
-        for series in self._data.get(name, {}).values():
-            if matchers:
-                labels = dict(series.labels)
-                if any(labels.get(k) != v for k, v in matchers.items()):
+        for series in series_list:
+            # Inlined _Series.latest_point_at (a fleet-wide matcher query
+            # walks ~1000 series; the per-series call was the loop's cost):
+            # at >= newest is the fast path, history bisects, NaN (staleness
+            # marker, value != value) and lookback-expired points end it.
+            tslist = series.ts
+            if not tslist:
+                continue
+            if at >= tslist[-1]:
+                idx = len(tslist) - 1
+            else:
+                idx = bisect_right(tslist, at) - 1
+                if idx < 0:
                     continue
-            point = series.latest_point_at(at, self.lookback)
-            if point is not None:
-                ts, value, origin = point
-                if self._capture is not None:
-                    self._capture.append((name, series.labels, ts, value, origin))
-                out.append(Sample(value, series.labels))
+            point = series.points[idx]
+            value = point[1]
+            if value != value or at - point[0] > lookback:
+                continue
+            if capture is not None:
+                capture.append((name, series.labels, point[0], value, point[2]))
+            out.append(Sample(value, series.labels))
         return out
 
     def latest(self, name: str, matchers: dict[str, str] | None = None) -> float | None:
@@ -137,6 +321,24 @@ class TimeSeriesDB:
         these when a target fails to scrape or a rule stops producing)."""
         self.append(name, labels, float("nan"), ts, origin=origin)
 
+    def version(self, name: str) -> int:
+        """Monotonic write counter for ``name``: bumps on every append to any
+        series of the name (staleness markers included).  Incremental rule
+        evaluation compares these between evals to detect dirty inputs."""
+        return self._versions.get(name, 0)
+
+    def total_points(self) -> int:
+        """Points currently retained across all series — the bench's memory
+        proxy (bounded retention keeps this flat over any horizon)."""
+        return self._total_points
+
+    def total_appends(self) -> int:
+        """Lifetime appends across all names (trim/GC never subtract)."""
+        return sum(self._versions.values())
+
+    def series_count(self) -> int:
+        return sum(len(by_name) for by_name in self._data.values())
+
     def series_names(self) -> list[str]:
         return sorted(self._data)
 
@@ -157,15 +359,29 @@ class TimedExposition:
 
 
 @dataclass
+class StructuredExposition:
+    """Pre-parsed exposition: the structured scrape fast path with a modeled
+    duration.  Same deadline semantics as ``TimedExposition``; the families
+    skip the text encode/parse round trip entirely.  Sample label tuples must
+    be canonically sorted (``Sample.make`` / ``MetricFamily.add`` guarantee
+    this) — they become TSDB series keys verbatim."""
+
+    families: list[MetricFamily]
+    duration: float = 0.0
+
+
+@dataclass
 class ScrapeTarget:
-    """One endpoint: ``fetch`` returns exposition text (HTTP GET in production).
+    """One endpoint: ``fetch`` returns exposition — text (HTTP GET in
+    production), or pre-parsed families (``list[MetricFamily]`` /
+    ``StructuredExposition``) for in-process targets on the fast path.
 
     ``attached_labels`` are merged onto every scraped sample, overriding any
     collision — this implements the reference's relabel_config that stamps the
     Kubernetes node name onto each sample (kube-prometheus-stack-values.yaml:13-16).
     """
 
-    fetch: Callable[[], "str | TimedExposition"]
+    fetch: Callable[[], "str | TimedExposition | list[MetricFamily] | StructuredExposition"]
     attached_labels: dict[str, str] = field(default_factory=dict)
     name: str = ""
     healthy: bool = True
@@ -184,6 +400,12 @@ class ScrapeTarget:
     #: came from (the node exporter's last collection sweep) — the scrape
     #: span links to it, rooting metric lineage at the raw chip samples
     trace_origin: "Callable[[], int | None] | None" = None
+    #: lazily cached ``up`` label set (attached labels + target name are
+    #: fixed after add_target; rebuilding the tuple per scrape was waste)
+    up_labels: LabelSet | None = field(default=None, repr=False)
+    #: sample labels -> merged+sorted TSDB key, cached because a target
+    #: exposes the same label sets scrape after scrape
+    merge_cache: dict[LabelSet, LabelSet] = field(default_factory=dict, repr=False)
 
 
 class Scraper:
@@ -234,9 +456,11 @@ class Scraper:
         self.targets.remove(target)
 
     def _up_labels(self, target: ScrapeTarget) -> LabelSet:
-        labels = dict(target.attached_labels)
-        labels["target"] = target.name or "?"
-        return tuple(sorted(labels.items()))
+        if target.up_labels is None:
+            labels = dict(target.attached_labels)
+            labels["target"] = target.name or "?"
+            target.up_labels = tuple(sorted(labels.items()))
+        return target.up_labels
 
     def _record_up(self, target: ScrapeTarget, value: float, ts: float) -> None:
         self.db.append("up", self._up_labels(target), value, ts)
@@ -257,32 +481,51 @@ class Scraper:
         window), an ``up`` sample of 0, and an exponential backoff before the
         next attempt.  Returns number of samples ingested."""
         count = 0
+        # per-sweep invariants, hoisted: a 1000-target fleet pays every
+        # per-target attribute chase 1000 times per tick (the clock cannot
+        # advance inside a sweep, so one ts per sweep is not a semantic
+        # change for virtual time and is sub-ms skew for wall time)
+        ts = self.db.clock.now()
+        tracer = self.tracer
+        selfmetrics = self.selfmetrics
+        db_append = self.db.append
         for target in self.targets:
-            ts = self.db.clock.now()
             if ts < target.next_attempt_at:
                 continue  # backing off after consecutive failures
             target.attempts += 1
             span = (
-                self.tracer.open("scrape", {"target": target.name or "?"})
-                if self.tracer is not None
+                tracer.open("scrape", {"target": target.name or "?"})
+                if tracer is not None
                 else None
             )
             origin = None if span is None else span.span_id
-            wall_start = time.perf_counter()
+            # wall_start only feeds self-metrics; skip the syscall pair per
+            # target when nothing consumes it (1000-target fleets scrape hot)
+            wall_start = 0.0 if selfmetrics is None else time.perf_counter()
             duration: float | None = None
             try:
                 fetched = target.fetch()
-                if isinstance(fetched, TimedExposition):
+                families: list[MetricFamily] | None
+                # dispatch cheapest-first: bare family lists are the fleet
+                # fast path and the common case at scale
+                if type(fetched) is list:
+                    families = fetched
+                elif isinstance(fetched, str):
+                    families = None
+                elif isinstance(fetched, TimedExposition):
                     duration = fetched.duration
-                    if fetched.duration > target.deadline:
-                        raise ScrapeTimeout(
-                            f"{target.name or '?'}: scrape took "
-                            f"{fetched.duration:.1f}s > deadline "
-                            f"{target.deadline:.1f}s"
-                        )
-                    text = fetched.text
-                else:
-                    text = fetched
+                    families = None
+                elif isinstance(fetched, StructuredExposition):
+                    duration = fetched.duration
+                    families = fetched.families
+                else:  # e.g. a list subclass: still the structured path
+                    families = list(fetched)
+                if duration is not None and duration > target.deadline:
+                    raise ScrapeTimeout(
+                        f"{target.name or '?'}: scrape took "
+                        f"{duration:.1f}s > deadline "
+                        f"{target.deadline:.1f}s"
+                    )
             except Exception as exc:
                 if target.healthy:
                     for name, labels in target.last_series:
@@ -292,35 +535,57 @@ class Scraper:
                 target.consecutive_failures += 1
                 self._backoff(target, ts)
                 self._record_up(target, 0.0, ts)
-                self._observe_scrape(target, wall_start, duration)
+                if selfmetrics is not None:
+                    self._observe_scrape(target, wall_start, duration)
                 if span is not None:
-                    self.tracer.close(span, ok=False, error=str(exc))
+                    tracer.close(span, ok=False, error=str(exc))
                 continue
             target.healthy = True
             target.consecutive_failures = 0
             target.next_attempt_at = -math.inf
+            if families is None:
+                # conformance fallback: parse the text exposition exactly as
+                # a real scraper would (tests prove path equivalence)
+                text = fetched.text if isinstance(fetched, TimedExposition) else fetched
+                families = parse_text(text)
             produced: set[tuple[str, LabelSet]] = set()
-            for fam in parse_text(text):
+            attached = target.attached_labels
+            merge_cache = target.merge_cache
+            for fam in families:
+                fam_name = fam.name
                 for sample in fam.samples:
-                    labels = dict(sample.labels)
-                    labels.update(target.attached_labels)
-                    key = tuple(sorted(labels.items()))
-                    self.db.append(fam.name, key, sample.value, ts, origin=origin)
-                    produced.add((fam.name, key))
+                    if attached:
+                        key = merge_cache.get(sample.labels)
+                        if key is None:
+                            merged = dict(sample.labels)
+                            merged.update(attached)
+                            key = tuple(sorted(merged.items()))
+                            merge_cache[sample.labels] = key
+                    else:
+                        # parse_text and Sample.make both emit sorted label
+                        # tuples, so the sample's labels ARE the series key
+                        key = sample.labels
+                    db_append(fam_name, key, sample.value, ts, origin=origin)
+                    produced.add((fam_name, key))
                     count += 1
             # series that vanished from the exposition also go stale
             for name, labels in target.last_series - produced:
                 self.db.mark_stale(name, labels, ts, origin=origin)
             target.last_series = produced
-            self._record_up(target, 1.0, ts)
-            self._observe_scrape(target, wall_start, duration)
+            # inlined _record_up (hot: once per healthy target per sweep)
+            up_labels = target.up_labels
+            if up_labels is None:
+                up_labels = self._up_labels(target)
+            db_append("up", up_labels, 1.0, ts)
+            if selfmetrics is not None:
+                self._observe_scrape(target, wall_start, duration)
             if span is not None:
                 links: tuple[int, ...] = ()
                 if target.trace_origin is not None:
                     upstream = target.trace_origin()
                     if upstream is not None:
                         links = (upstream,)
-                self.tracer.close(span, links, ok=True, samples=len(produced))
+                tracer.close(span, links, ok=True, samples=len(produced))
         return count
 
     def _observe_scrape(

@@ -166,6 +166,12 @@ def test_time_budget_completes_unattended_with_labeled_skips():
     # the near-free virtual phases still ran: a budget must never cost them
     assert final["rungs"]["0_cpu_resource"]["replicas_reached"] == 4
     assert final["rungs"]["4_multihost_quantum"]["slice_boundary_violations"] == 0
+    # sim_scale rung contract: the fleet-scale plane reports its speedup,
+    # retention bound, and query tail on every bench run
+    sim_scale = final["rungs"]["sim_scale"]
+    for key in ("speedup", "peak_retained_points", "query_p95_ms"):
+        assert key in sim_scale, f"sim_scale rung missing {key!r}"
+    assert sim_scale["meets_floor"] is True
     assert [c["pod_start_s"] for c in final["pod_start_sensitivity"]] == [
         12.0,
         30.0,

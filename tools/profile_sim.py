@@ -1,0 +1,103 @@
+"""Profile/assert harness for the fleet-scale metrics plane.
+
+Runs ``control/scale_harness.run_fleet_scale`` standalone — no bench.py,
+no jax import — so it doubles as the tier-1 ``sim_scale`` smoke and as a
+cProfile entry point when the plane regresses:
+
+Usage:
+    python tools/profile_sim.py                          # full 1000x1h run
+    python tools/profile_sim.py --targets 200 --horizon 600
+    python tools/profile_sim.py --profile                # cProfile top-25
+    python tools/profile_sim.py --json                   # machine output
+    python tools/profile_sim.py --targets 100 --horizon 600 \
+        --assert-min-speedup 20 --assert-max-points 40000   # CI smoke
+
+The assert flags turn the report into a pass/fail gate: exit 1 (with the
+numbers printed) when the virtual/wall speedup drops below the floor or
+the retained-point peak exceeds the bound — i.e. retention stopped
+trimming or a hot path went quadratic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_gpu_hpa_tpu.control.scale_harness import run_fleet_scale
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--targets", type=int, default=1000)
+    parser.add_argument("--horizon", type=float, default=3600.0)
+    parser.add_argument("--scrape-interval", type=float, default=15.0)
+    parser.add_argument("--rule-interval", type=float, default=5.0)
+    parser.add_argument(
+        "--profile", action="store_true", help="run under cProfile, print top-25"
+    )
+    parser.add_argument("--json", action="store_true", help="emit one JSON object")
+    parser.add_argument(
+        "--assert-min-speedup",
+        type=float,
+        default=None,
+        help="exit 1 unless virtual/wall speedup >= this",
+    )
+    parser.add_argument(
+        "--assert-max-points",
+        type=int,
+        default=None,
+        help="exit 1 unless peak retained points <= this",
+    )
+    args = parser.parse_args(argv)
+
+    def run() -> dict:
+        return run_fleet_scale(
+            targets=args.targets,
+            horizon_s=args.horizon,
+            scrape_interval=args.scrape_interval,
+            rule_interval=args.rule_interval,
+        )
+
+    if args.profile:
+        import cProfile
+        import pstats
+
+        profiler = cProfile.Profile()
+        result = profiler.runcall(run)
+        pstats.Stats(profiler).sort_stats("cumulative").print_stats(25)
+    else:
+        result = run()
+
+    if args.json:
+        print(json.dumps(result))
+    else:
+        for key, value in result.items():
+            print(f"{key:>24}: {value}")
+
+    failures = []
+    if (
+        args.assert_min_speedup is not None
+        and result["speedup"] < args.assert_min_speedup
+    ):
+        failures.append(
+            f"speedup {result['speedup']} < floor {args.assert_min_speedup}"
+        )
+    if (
+        args.assert_max_points is not None
+        and result["peak_retained_points"] > args.assert_max_points
+    ):
+        failures.append(
+            f"peak_retained_points {result['peak_retained_points']} > "
+            f"bound {args.assert_max_points}"
+        )
+    for failure in failures:
+        print(f"ASSERT FAILED: {failure}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,4 +10,10 @@ set -o pipefail
 # trace-schema lint: the live emitters must still speak obs/schema.py's span
 # table (runs a short traced sim in-process and lints its JSONL export)
 python tools/lint_trace_schema.py --selfcheck || exit 1
+# sim_scale smoke: the fleet-scale metrics plane must stay fast (virtual/wall
+# speedup floor) and bounded (retention must keep trimming); small sizing —
+# the full 1000x1h rung runs in bench.py (~8000x observed here, floor 20x
+# absorbs CI-host noise; the point bound is deterministic, observed 14815)
+python tools/profile_sim.py --targets 100 --horizon 600 \
+  --assert-min-speedup 20 --assert-max-points 25000 || exit 1
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
