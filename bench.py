@@ -1663,6 +1663,36 @@ def run_rung_signal_latency() -> dict:
     }
 
 
+def run_rung_recovery_drill() -> dict:
+    """Control-plane crash/restart rung (control/scale_harness.py): a fully
+    durable pipeline (TSDB WAL + HPA checkpoint, traced) holds steady at 3
+    replicas while each component — TSDB, HPA, adapter, plus a WAL-tail
+    truncation — is killed and rebuilt from durable state mid-run, then the
+    load surges so a genuine post-restart scale event proves metric lineage
+    survives every restart boundary.  The acceptance bar: every restart
+    recovers, ZERO scale events during any replay window, lineage complete."""
+    from k8s_gpu_hpa_tpu.control.scale_harness import run_recovery_drill
+
+    result = run_recovery_drill(pod_start_latency=BASE_POD_START_LATENCY)
+    return {
+        "mode": "virtual",
+        "metric": "recovery drill MTTR (s, restart -> reconverged)",
+        "components": result["components"],
+        "settled_replicas": result["settled_replicas"],
+        "mttr_s": {f["fault"]: f["mttr"] for f in result["faults"]},
+        "mttr_max_s": result["mttr_max_s"],
+        "replay_gap_max_s": result["replay_gap_max_s"],
+        "first_good_sync_max_s": result["first_good_sync_max_s"],
+        "all_recovered": result["all_recovered"],
+        "spurious_scale_events_during_replay": result[
+            "spurious_scale_events_during_replay"
+        ],
+        "lineage_complete": result["lineage_complete"],
+        "final_replicas": result["final_replicas"],
+        "ok": result["ok"],
+    }
+
+
 def run_rung_sim_scale() -> dict:
     """Fleet-scale metrics-plane rung (control/scale_harness.py): a full
     pipeline plus 1000 synthetic structured scrape targets driven over a
@@ -2086,6 +2116,7 @@ def main() -> None:
             ("chaos_storm", run_rung_chaos),
             ("signal_latency", run_rung_signal_latency),
             ("sim_scale", run_rung_sim_scale),
+            ("recovery_drill", run_rung_recovery_drill),
         ):
             log(f"rung {name}:")
             try:

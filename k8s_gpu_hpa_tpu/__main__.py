@@ -196,7 +196,16 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
     sim.add_argument(
         "--scenario",
-        choices=["spike", "ramp", "flap", "outage", "crash", "chaos", "trace"],
+        choices=[
+            "spike",
+            "ramp",
+            "flap",
+            "outage",
+            "crash",
+            "chaos",
+            "trace",
+            "drill",
+        ],
         default="spike",
     )
     sim.add_argument("--duration", type=float, default=420.0)
@@ -214,6 +223,12 @@ def main(argv: list[str] | None = None) -> int:
         "caps the simulated per-pod gauge so an inert manifest/workload "
         "pairing (ceiling below target x 1.1) is diagnosed instead of "
         "simulated as healthy",
+    )
+    sim.add_argument(
+        "--components",
+        default=None,
+        help="comma list of components --scenario drill restarts "
+        "(tsdb,hpa,adapter,wal); default all",
     )
 
     genm = sub.add_parser(

@@ -18,10 +18,20 @@ kind                      layer it breaks
 ``pod_crash``             L1: one pod dies once, replacement pays start latency
 ``crashloop``             L1: containers crash on start → CrashLoopBackOff
 ``adapter_blackout``      L4: custom-metrics API answers nothing
+``tsdb_restart``          L3: Prometheus crash — TSDB torn down, rebuilt from
+                          its WAL (cold-empty when none is attached)
+``hpa_restart``           L5: controller failover — HPAController rebuilt,
+                          restored from its checkpoint store
+``adapter_restart``       L4: custom-metrics API pod replaced (stateless)
+``wal_truncate``          durability: destroy the WAL tail (torn record
+                          included), then crash+recover the TSDB
 ========================  =====================================================
 
 Injectors return a ``clear()`` callable that undoes the fault; duration-0
-faults (``pod_crash``) are impulses and clear immediately.
+faults (``pod_crash``, the restart kinds) are impulses and clear immediately.
+``clear()`` is idempotent and safe under overlapping fault windows: a
+scrape-path target is restored to its pristine fetch only when the LAST
+overlapping fault over it clears, whatever order the windows close in.
 """
 
 from __future__ import annotations
@@ -82,13 +92,31 @@ def _scrape_targets(
 
 
 def _wrap_fetch(targets: list[ScrapeTarget], make_fetch) -> ClearFn:
-    originals = [(t, t.fetch) for t in targets]
-    for target, original in originals:
-        target.fetch = make_fetch(target, original)
+    """Wrap each target's fetch, returning an idempotent, overlap-safe
+    ``clear``.  Overlapping faults stack (each wraps whatever fetch is in
+    force), and a per-target depth counter restores the PRISTINE fetch only
+    when the last overlapping fault clears — naively restoring the fetch
+    captured at inject time would resurrect an already-cleared fault when
+    windows close out of order."""
+    wrapped: list[ScrapeTarget] = []
+    for target in targets:
+        depth = getattr(target, "_fault_depth", 0)
+        if depth == 0:
+            target._pristine_fetch = target.fetch
+        target._fault_depth = depth + 1
+        target.fetch = make_fetch(target, target.fetch)
+        wrapped.append(target)
+    cleared = False
 
     def clear() -> None:
-        for target, original in originals:
-            target.fetch = original
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        for target in wrapped:
+            target._fault_depth -= 1
+            if target._fault_depth == 0:
+                target.fetch = target._pristine_fetch
 
     return clear
 
@@ -218,9 +246,47 @@ def _inject_adapter_blackout(pipe: "AutoscalingPipeline", spec: FaultSpec) -> Cl
     pipe.hpa.adapter = _BlackoutAdapter()
 
     def clear() -> None:
-        pipe.hpa.adapter = real
+        # an overlapping adapter_restart may have replaced the adapter while
+        # the blackout was in force; only swap the real one back if the
+        # blackout stand-in is still installed
+        if isinstance(pipe.hpa.adapter, _BlackoutAdapter):
+            pipe.hpa.adapter = real
 
     return clear
+
+
+def _inject_tsdb_restart(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """Impulse: crash the TSDB and rebuild it from its WAL (params:
+    ``from_wal=False`` forces the cold-empty pre-durability path)."""
+    pipe.restart_tsdb(from_wal=bool(spec.params.get("from_wal", True)))
+    return lambda: None
+
+
+def _inject_hpa_restart(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """Impulse: controller failover — a fresh HPAController restored from
+    the pipeline's checkpoint store (cold when none is attached)."""
+    pipe.restart_hpa()
+    return lambda: None
+
+
+def _inject_adapter_restart(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """Impulse: replace the custom-metrics adapter (stateless rewiring)."""
+    pipe.restart_adapter()
+    return lambda: None
+
+
+def _inject_wal_truncate(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """Impulse: destroy the WAL tail — ``records`` complete records plus a
+    torn partial one (``tear=False`` to skip it) — then crash+recover the
+    TSDB, so the drill measures recovery FROM the damaged log."""
+    if pipe.wal is None:
+        raise ValueError("wal_truncate: pipeline has no WAL attached")
+    pipe.wal.truncate_tail(
+        records=int(spec.params.get("records", 64)),
+        tear=bool(spec.params.get("tear", True)),
+    )
+    pipe.restart_tsdb()
+    return lambda: None
 
 
 FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = {
@@ -233,4 +299,8 @@ FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = 
     "pod_crash": _inject_pod_crash,
     "crashloop": _inject_crashloop,
     "adapter_blackout": _inject_adapter_blackout,
+    "tsdb_restart": _inject_tsdb_restart,
+    "hpa_restart": _inject_hpa_restart,
+    "adapter_restart": _inject_adapter_restart,
+    "wal_truncate": _inject_wal_truncate,
 }

@@ -35,6 +35,11 @@ class RecoveryReport:
     - ``mttr``: cleared → recovered — the pipeline's own recovery work,
       excluding the fault's dwell time.  A fault nobody noticed (e.g. a
       tolerated single-exporter blip) recovers with ``detected_at is None``.
+    - ``replay_gap``: for restart faults, how far behind real time the
+      recovered component's durable state was (seconds of data the replay
+      could not restore) — stamped from ``pipeline.restart_log``.
+    - ``time_to_first_good_sync``: cleared → the HPA's first sync that
+      computed a valid replica count (``last_good_sync_at``).
     """
 
     fault: FaultSpec
@@ -43,6 +48,8 @@ class RecoveryReport:
     detected_at: float | None = None
     recovered_at: float | None = None
     expected_replicas: int | None = None
+    replay_gap: float | None = None
+    first_good_sync_at: float | None = None
     #: id of the fault_window span covering injected→recovered, when the
     #: pipeline is traced — the hook from chaos reports into the trace
     trace_span_id: int | None = None
@@ -66,6 +73,12 @@ class RecoveryReport:
         return max(0.0, self.recovered_at - self.cleared_at)
 
     @property
+    def time_to_first_good_sync(self) -> float | None:
+        if self.first_good_sync_at is None or self.cleared_at is None:
+            return None
+        return max(0.0, self.first_good_sync_at - self.cleared_at)
+
+    @property
     def recovered(self) -> bool:
         return self.recovered_at is not None
 
@@ -83,6 +96,8 @@ class RecoveryReport:
             "detection_time": r(self.detection_time),
             "degraded_duration": r(self.degraded_duration),
             "mttr": r(self.mttr),
+            "replay_gap": r(self.replay_gap),
+            "time_to_first_good_sync": r(self.time_to_first_good_sync),
             "recovered": self.recovered,
             "trace_span_id": self.trace_span_id,
         }
@@ -147,7 +162,16 @@ class ChaosSchedule:
         # the pre-fault replica count, recorded for the report (callers
         # assert final convergence against it when load is held constant)
         armed.report.expected_replicas = self.pipeline.deployment.replicas
+        restarts_before = len(getattr(self.pipeline, "restart_log", []))
         armed.clear_fn = FAULT_KINDS[armed.spec.kind](self.pipeline, armed.spec)
+        # restart faults leave recovery stats in the pipeline's restart log;
+        # the worst replay gap among this fault's restarts goes on the report
+        for entry in getattr(self.pipeline, "restart_log", [])[restarts_before:]:
+            gap = entry.get("replay_gap_seconds")
+            if gap is not None and (
+                armed.report.replay_gap is None or gap > armed.report.replay_gap
+            ):
+                armed.report.replay_gap = gap
         if armed.spec.duration <= 0:  # impulse fault: nothing to undo later
             self._clear(armed)
 
@@ -197,6 +221,12 @@ class ChaosSchedule:
             if not healthy and report.detected_at is None:
                 report.detected_at = now
             if report.cleared_at is not None:
+                if report.first_good_sync_at is None:
+                    last_good = getattr(
+                        self.pipeline.hpa, "last_good_sync_at", None
+                    )
+                    if last_good is not None and last_good >= report.cleared_at:
+                        report.first_good_sync_at = last_good
                 if healthy:
                     if current.healthy_since is None:
                         current.healthy_since = now
@@ -221,6 +251,10 @@ class ChaosSchedule:
             attrs["detected_at"] = report.detected_at
         if report.mttr is not None:
             attrs["mttr"] = report.mttr
+        if report.replay_gap is not None:
+            attrs["replay_gap"] = report.replay_gap
+        if report.time_to_first_good_sync is not None:
+            attrs["time_to_first_good_sync"] = report.time_to_first_good_sync
         span = tracer.emit(
             "fault_window",
             attrs,

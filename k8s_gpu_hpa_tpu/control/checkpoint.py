@@ -1,0 +1,83 @@
+"""Controller state checkpointing (the HPA's durability half of ISSUE 4).
+
+kube-controller-manager's HPA survives leader failover because its inputs
+are API objects: the scale subresource, the HPA status, and (implicitly)
+the assumption that stabilization history is cheap to lose.  In practice a
+restarted controller that forgets its recommendation window CAN flap — a
+scale-down recommended 10 s before the crash re-fires immediately after,
+skipping the rest of ``scaleDown.stabilizationWindowSeconds``.  The sim
+makes that state durable: `HPAController` writes a small JSON document
+after every sync and restores it on construction, so a restart is
+semantically invisible to the v2 algorithm (tests prove the restarted
+controller's recommendation sequence matches an uninterrupted one).
+
+Schema (``version: 1``) — everything ``_sync_inner`` reads across syncs:
+``recommendations`` (the stabilization ring), ``scale_events`` (policy
+period lookback), ``last_good_sync_at``, and the last ``HPAStatus``
+(desired replicas, metric values, reason, conditions with transition
+times) plus the condition history.  ``current_replicas`` is deliberately
+NOT restored — the scale target remains authoritative for that, exactly
+as the real controller re-reads the scale subresource.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Protocol
+
+CHECKPOINT_VERSION = 1
+
+
+class CheckpointStore(Protocol):
+    """Where a controller persists its sync-to-sync state.  ``load`` returns
+    None when there is nothing (or nothing readable) to restore — a cold
+    start, never an error."""
+
+    def save(self, state: dict) -> None: ...
+
+    def load(self) -> dict | None: ...
+
+
+class InMemoryCheckpointStore:
+    """Durable only across object lifetimes, not processes — the restart
+    faults' store (the chaos injectors rebuild the controller in-process)
+    and the test default."""
+
+    def __init__(self) -> None:
+        self._state: dict | None = None
+        self.saves = 0
+
+    def save(self, state: dict) -> None:
+        # round-trip through JSON so in-memory behavior can never be more
+        # permissive than the file store (e.g. tuple keys, NaN)
+        self._state = json.loads(json.dumps(state, allow_nan=False))
+        self.saves += 1
+
+    def load(self) -> dict | None:
+        return self._state
+
+
+class FileCheckpointStore:
+    """Atomic single-file JSON store (tmp + ``os.replace``).  A missing or
+    torn file loads as None: a controller must always come up, at worst
+    cold."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = Path(path)
+
+    def save(self, state: dict) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.path.with_suffix(self.path.suffix + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(state, fh, separators=(",", ":"), allow_nan=False)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+
+    def load(self) -> dict | None:
+        try:
+            return json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return None

@@ -343,6 +343,7 @@ class HPAController:
         namespace: str = "default",
         tracer=None,
         selfmetrics=None,
+        checkpoint_store=None,
     ):
         self.target = target
         self.metrics = metrics
@@ -385,6 +386,78 @@ class HPAController:
         self._recommendations: list[tuple[float, int]] = []
         #: (ts, replicas_after) scale-event log for policy period lookback
         self._scale_events: list[tuple[float, int]] = [(clock.now(), target.replicas)]
+        #: conservative-assumption notes from the current sync's proposals
+        #: (missing-pod semantics), appended to ``last_reason``
+        self._proposal_notes: list[str] = []
+        #: clock time of the last sync that computed a valid replica count
+        #: (ScalingActive true) — the recovery drill's time-to-first-good-sync
+        self.last_good_sync_at: float | None = None
+        #: control.checkpoint.CheckpointStore: sync-to-sync durable state.
+        #: Restored here, at construction, so a restarted controller honors
+        #: in-flight stabilization windows instead of flapping.
+        self.checkpoint_store = checkpoint_store
+        self.restored_from_checkpoint = False
+        if checkpoint_store is not None:
+            self.restored_from_checkpoint = self._restore_checkpoint()
+
+    # ---- durable state (control/checkpoint.py) -----------------------------
+
+    def _checkpoint_state(self) -> dict:
+        return {
+            "version": 1,
+            "saved_at": self.clock.now(),
+            "recommendations": [list(r) for r in self._recommendations],
+            "scale_events": [list(e) for e in self._scale_events],
+            "last_good_sync_at": self.last_good_sync_at,
+            "status": {
+                "desired_replicas": self.status.desired_replicas,
+                "last_metric_values": dict(self.status.last_metric_values),
+                "last_scale_time": self.status.last_scale_time,
+                "last_reason": self.status.last_reason,
+                "conditions": [
+                    [c.type, c.status, c.reason, c.message, c.last_transition_time]
+                    for c in self.status.conditions.values()
+                ],
+            },
+            "condition_history": [list(h) for h in self.condition_history],
+        }
+
+    def _save_checkpoint(self) -> None:
+        if self.checkpoint_store is not None:
+            self.checkpoint_store.save(self._checkpoint_state())
+
+    def _restore_checkpoint(self) -> bool:
+        """Adopt the store's state if present and schema-compatible.  The
+        scale target stays authoritative for ``current_replicas`` — a
+        checkpoint can never lie about the world, only about history."""
+        state = self.checkpoint_store.load()
+        if not state or state.get("version") != 1:
+            return False
+        self._recommendations = [
+            (float(ts), int(rec)) for ts, rec in state.get("recommendations", [])
+        ]
+        events = [
+            (float(ts), int(n)) for ts, n in state.get("scale_events", [])
+        ]
+        if events:
+            self._scale_events = events
+        self.last_good_sync_at = state.get("last_good_sync_at")
+        status = state.get("status", {})
+        self.status.desired_replicas = int(
+            status.get("desired_replicas", self.target.replicas)
+        )
+        self.status.last_metric_values = dict(status.get("last_metric_values", {}))
+        self.status.last_scale_time = status.get("last_scale_time")
+        self.status.last_reason = status.get("last_reason", "")
+        for type_, st, reason, message, transition in status.get("conditions", []):
+            self.status.conditions[type_] = HPACondition(
+                type_, bool(st), reason, message, transition
+            )
+        self.condition_history = [
+            (float(ts), type_, bool(st), reason)
+            for ts, type_, st, reason in state.get("condition_history", [])
+        ]
+        return True
 
     # ---- status conditions -------------------------------------------------
 
@@ -438,9 +511,35 @@ class HPAController:
             )
             if not values:
                 return None
-            value = sum(values.values()) / len(values)
-            self.status.last_metric_values[f"pods/{spec.metric_name}"] = value
             target = spec.target_average_value
+            value = sum(values.values()) / len(values)
+            missing = len(pods) - len(values)
+            if missing > 0 and abs(value / target - 1.0) > self.TOLERANCE:
+                # K8s conservative missing-pod semantics (replica_calculator):
+                # never let pods without samples amplify the move.  Toward
+                # scale-up they count as 0% (dilute the average); toward
+                # scale-down they count at 100% of target (resist it).  If
+                # the assumption erases or flips the signal, hold.
+                if value > target:
+                    adjusted = sum(values.values()) / len(pods)
+                    assumed = "0"
+                else:
+                    adjusted = (sum(values.values()) + target * missing) / len(pods)
+                    assumed = "target"
+                note = (
+                    f"{missing}/{len(pods)} pods missing {spec.metric_name}; "
+                    f"assumed {assumed}"
+                )
+                flipped = (adjusted > target) != (value > target)
+                if flipped or abs(adjusted / target - 1.0) <= self.TOLERANCE:
+                    self._proposal_notes.append(note + "; held")
+                    self.status.last_metric_values[
+                        f"pods/{spec.metric_name}"
+                    ] = adjusted
+                    return current
+                self._proposal_notes.append(note)
+                value = adjusted
+            self.status.last_metric_values[f"pods/{spec.metric_name}"] = value
         elif isinstance(spec, ExternalMetricSpec):
             if self.adapter is None:
                 return None
@@ -536,7 +635,9 @@ class HPAController:
         when replicas change, is followed by a ``scale_event`` span — the root
         every lineage walk starts from."""
         if self.tracer is None and self.selfmetrics is None:
-            return self._sync_inner()
+            status = self._sync_inner()
+            self._save_checkpoint()
+            return status
         before = self.target.replicas
         wall_start = time.perf_counter()
         span = None
@@ -566,11 +667,13 @@ class HPAController:
                     {"from_replicas": before, "to_replicas": after},
                     links=(span.span_id,),
                 )
+        self._save_checkpoint()
         return status
 
     def _sync_inner(self) -> HPAStatus:
         current = self.target.replicas
         self.status.current_replicas = current
+        self._proposal_notes = []
         self._set_condition(
             "AbleToScale",
             True,
@@ -598,6 +701,7 @@ class HPAController:
             "ValidMetricFound",
             "the HPA was able to successfully calculate a replica count",
         )
+        self.last_good_sync_at = self.clock.now()
 
         recommendation = max(valid)  # multiple metrics -> largest proposal
         recommendation = min(max(recommendation, self.min_replicas), self.max_replicas)
@@ -637,6 +741,8 @@ class HPAController:
                 # releasing the stranded hosts — they serve nothing anyway.
                 desired = max(desired // q * q, min_q)
                 reason = f"repair partial slice {current}->{desired}"
+        if self._proposal_notes:
+            reason += " [" + "; ".join(self._proposal_notes) + "]"
         self.status.desired_replicas = desired
         self.status.last_reason = reason
 

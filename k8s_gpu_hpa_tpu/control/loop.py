@@ -65,11 +65,22 @@ class AutoscalingPipeline:
         extra_adapter_rules: list[AdapterRule] | None = None,
         tracer=None,
         structured_scrapes: bool = True,
+        wal=None,
+        checkpoint_store=None,
     ):
         self.cluster = cluster
         self.deployment = deployment
         self.intervals = intervals or PipelineIntervals()
         clock: VirtualClock = cluster.clock
+
+        # Durability wiring (ISSUE 4): a WriteAheadLog makes the TSDB
+        # recoverable, a CheckpointStore makes the HPA's sync-to-sync state
+        # survive a rebuild; the restart_* methods below are the crash+
+        # recovery path the chaos restart faults drive.
+        self.wal = wal
+        self.checkpoint_store = checkpoint_store
+        #: one entry per component restart (component, at, recovery stats)
+        self.restart_log: list[dict] = []
 
         # Observability wiring (obs/): pass an obs.Tracer to get spans from
         # every stage, PipelineSelfMetrics served as one more scrape target,
@@ -83,7 +94,7 @@ class AutoscalingPipeline:
             cluster.tracer = tracer
             self.selfmetrics = PipelineSelfMetrics()
 
-        self.db = TimeSeriesDB(clock)
+        self.db = TimeSeriesDB(clock, wal=wal)
         self.scraper = Scraper(
             self.db,
             interval=self.intervals.scrape,
@@ -195,6 +206,7 @@ class AutoscalingPipeline:
             namespace=deployment.namespace,
             tracer=tracer,
             selfmetrics=self.selfmetrics,
+            checkpoint_store=checkpoint_store,
         )
         self.scale_history: list[tuple[float, int, int]] = []  # (ts, from, to)
         self.hpa.on_scale = lambda a, b: self.scale_history.append((clock.now(), a, b))
@@ -208,13 +220,19 @@ class AutoscalingPipeline:
         return self._clock
 
     def start(self) -> None:
-        """Register the periodic loops on the virtual clock."""
+        """Register the periodic loops on the virtual clock.  Each tick
+        resolves its component THROUGH ``self`` (late-bound), so a restart
+        that replaces ``self.scraper``/``self.evaluator``/``self.hpa`` takes
+        effect on the very next tick — a bound method captured here would
+        keep driving the torn-down instance forever."""
         if self._started:
             return
         self._started = True
-        self._periodic(self.intervals.scrape, self.scraper.scrape_once)
-        self._periodic(self.intervals.rule_eval, self.evaluator.evaluate_once)
-        self._periodic(self.intervals.hpa_sync, self.hpa.sync_once)
+        self._periodic(self.intervals.scrape, lambda: self.scraper.scrape_once())
+        self._periodic(
+            self.intervals.rule_eval, lambda: self.evaluator.evaluate_once()
+        )
+        self._periodic(self.intervals.hpa_sync, lambda: self.hpa.sync_once())
 
     def _periodic(self, interval: float, fn) -> None:
         def tick():
@@ -232,3 +250,104 @@ class AutoscalingPipeline:
 
     def running(self) -> int:
         return len(self.cluster.running_pods(self.deployment.name))
+
+    # ---- crash / restart (the chaos restart faults' teardown+rebuild) ------
+
+    def restart_tsdb(self, from_wal: bool = True) -> dict:
+        """Kill the TSDB and rebuild it — from its WAL when one is attached
+        (``TimeSeriesDB.recover``), cold-empty otherwise (the pre-durability
+        failure mode, kept reachable so drills can show the difference).
+        Every consumer holding a ``db`` reference is rewired, and the scraper
+        staggers its next sweep so the recovered plane is not hit by the
+        whole fleet on one tick."""
+        old = self.db
+        if from_wal and self.wal is not None:
+            from k8s_gpu_hpa_tpu.metrics.wal import WriteAheadLog
+
+            # a crashed process cannot reuse its file handles: a fresh WAL
+            # instance over the same directory opens a segment past any torn
+            # tail, exactly as a real restart would
+            self.wal.close()
+            self.wal = WriteAheadLog(
+                self.wal.directory, self.wal.segment_max_records
+            )
+            db = TimeSeriesDB.recover(
+                self.wal,
+                self._clock,
+                lookback=old.lookback,
+                retention=old.retention,
+                snapshot_every=old.snapshot_every,
+            )
+            info = dict(db.last_recovery or {})
+        else:
+            db = TimeSeriesDB(
+                self._clock, lookback=old.lookback, retention=old.retention
+            )
+            info = {"snapshot_restored": False, "recovered_points": 0}
+        self.db = db
+        self.scraper.db = db
+        self.evaluator.db = db
+        self.adapter.db = db
+        self.scraper.stagger_after_recovery()
+        return self._log_restart("tsdb", info)
+
+    def restart_hpa(self) -> dict:
+        """Kill the HPAController and construct a replacement — the same
+        wiring, restored from the checkpoint store when one is attached (the
+        new instance adopts the stabilization window and scale-event history
+        at construction, before its first sync)."""
+        old = self.hpa
+        self.hpa = HPAController(
+            target=old.target,
+            metrics=old.metrics,
+            adapter=self.adapter,
+            clock=self._clock,
+            min_replicas=old.min_replicas,
+            max_replicas=old.max_replicas,
+            behavior=old.behavior,
+            sync_interval=old.sync_interval,
+            on_scale=old.on_scale,
+            replica_quantum=old.replica_quantum,
+            resource_metrics=old.resource_metrics,
+            pod_lister=old.pod_lister,
+            namespace=old.namespace,
+            tracer=old.tracer,
+            selfmetrics=old.selfmetrics,
+            checkpoint_store=self.checkpoint_store,
+        )
+        return self._log_restart(
+            "hpa", {"checkpoint_restored": self.hpa.restored_from_checkpoint}
+        )
+
+    def restart_adapter(self) -> dict:
+        """Kill the CustomMetricsAdapter and rebuild it over the live DB.
+        The adapter is stateless between queries, so its restart is pure
+        rewiring — included so drills prove that, not because it is hard."""
+        old = self.adapter
+        self.adapter = CustomMetricsAdapter(
+            self.db,
+            list(old.rules.values()),
+            external_rules=list(old.external_rules.values()),
+            tracer=old.tracer,
+        )
+        self.hpa.adapter = self.adapter
+        return self._log_restart("adapter", {})
+
+    def _log_restart(self, component: str, info: dict) -> dict:
+        entry = {"component": component, "at": self._clock.now(), **info}
+        self.restart_log.append(entry)
+        if self.tracer is not None:
+            attrs = {"component": component}
+            for key in (
+                "snapshot_restored",
+                "recovered_series",
+                "recovered_points",
+                "replayed_records",
+                "dropped_records",
+                "replay_gap_seconds",
+                "checkpoint_restored",
+            ):
+                if info.get(key) is not None:
+                    attrs[key] = info[key]
+            self.tracer.emit("component_restart", attrs)
+        return entry

@@ -27,7 +27,9 @@ so two runs differ only in wall-clock numbers.
 from __future__ import annotations
 
 import gc
+import tempfile
 import time
+from pathlib import Path
 
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
 from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline, PipelineIntervals
@@ -187,3 +189,179 @@ def run_fleet_scale(
         "final_replicas": pipe.replicas(),
         "scale_events": len(pipe.scale_history),
     }
+
+
+# ---- recovery drill (ISSUE 4: durability under crash/restart) ---------------
+
+#: which restart fault each drillable component maps to
+DRILL_COMPONENTS = {
+    "tsdb": "tsdb_restart",
+    "hpa": "hpa_restart",
+    "adapter": "adapter_restart",
+    "wal": "wal_truncate",
+}
+
+
+def run_recovery_drill(
+    components: tuple[str, ...] = ("tsdb", "hpa", "adapter", "wal"),
+    pod_start_latency: float = 12.0,
+    settle_s: float = 120.0,
+    between_s: float = 180.0,
+    surge_s: float = 90.0,
+    stable_for: float = 10.0,
+) -> dict:
+    """Kill each requested control-plane component mid-run and measure the
+    recovery: a fully durable pipeline (WAL + HPA checkpoint + tracer) holds
+    steady at 3 replicas, each component is crashed and rebuilt in turn
+    (impulse restart faults on a ChaosSchedule), and finally the load surges
+    so a genuine post-restart scale event proves the trace is still
+    explicable end-to-end across every restart boundary.
+
+    The contract the rung asserts downstream: every fault recovers, ZERO
+    scale events land inside any fault's injected→recovered window (a
+    restart must never flap), and every scale event's lineage — including
+    the post-restart one — walks back to raw exporter sweeps.
+    """
+    from k8s_gpu_hpa_tpu.chaos import ChaosSchedule, FaultSpec
+    from k8s_gpu_hpa_tpu.control.checkpoint import FileCheckpointStore
+    from k8s_gpu_hpa_tpu.control.hpa import HPABehavior, ScalingPolicy, ScalingRules
+    from k8s_gpu_hpa_tpu.metrics.wal import WriteAheadLog
+    from k8s_gpu_hpa_tpu.obs import Tracer, index_spans, lineage_of
+
+    unknown = [c for c in components if c not in DRILL_COMPONENTS]
+    if unknown:
+        raise ValueError(
+            f"unknown drill component(s) {unknown}; "
+            f"have: {', '.join(sorted(DRILL_COMPONENTS))}"
+        )
+
+    with tempfile.TemporaryDirectory(prefix="recovery-drill-") as tmp:
+        clock = VirtualClock()
+        cluster = SimCluster(
+            clock,
+            nodes=[(f"drill-node-{i}", 2) for i in range(3)],
+            pod_start_latency=pod_start_latency,
+        )
+        state = {"load": 90.0}
+        dep = SimDeployment(
+            cluster,
+            "tpu-test",
+            "tpu-test",
+            load_fn=lambda t: state["load"],
+            load_mode="shared",
+        )
+        cluster.add_deployment(dep, replicas=1)
+        clock.advance(15.0)
+
+        tracer = Tracer(clock)
+        wal = WriteAheadLog(Path(tmp) / "wal", segment_max_records=512)
+        store = FileCheckpointStore(Path(tmp) / "hpa-checkpoint.json")
+        behavior = HPABehavior(
+            scale_down=ScalingRules(
+                stabilization_window_seconds=60.0,
+                policies=[ScalingPolicy("Percent", 100, 15.0)],
+            )
+        )
+        pipe = AutoscalingPipeline(
+            cluster,
+            dep,
+            target_value=40.0,
+            max_replicas=4,
+            behavior=behavior,
+            tracer=tracer,
+            wal=wal,
+            checkpoint_store=store,
+        )
+        pipe.run_for(settle_s)
+        settled = pipe.replicas()
+
+        faults = [
+            FaultSpec(kind=DRILL_COMPONENTS[c], at=30.0 + i * between_s)
+            for i, c in enumerate(components)
+        ]
+        schedule = ChaosSchedule(pipe, faults, stable_for=stable_for)
+        schedule.arm()
+        clock.advance(30.0 + len(faults) * between_s)
+
+        # post-restart surge: a genuine scale event AFTER every component has
+        # been torn down and rebuilt — the lineage-across-restart proof
+        state["load"] = 140.0
+        clock.advance(surge_s)
+
+        reports = [r.as_dict() for r in schedule.reports]
+        windows = [
+            (r.injected_at, r.recovered_at)
+            for r in schedule.reports
+            if r.injected_at is not None and r.recovered_at is not None
+        ]
+        spurious = sum(
+            1
+            for ts, _a, _b in pipe.scale_history
+            if any(start <= ts <= end for start, end in windows)
+        )
+        mttrs = [r.mttr for r in schedule.reports if r.mttr is not None]
+        gaps = [r.replay_gap for r in schedule.reports if r.replay_gap is not None]
+        syncs = [
+            r.time_to_first_good_sync
+            for r in schedule.reports
+            if r.time_to_first_good_sync is not None
+        ]
+        scale_spans = tracer.spans_of("scale_event")
+        by_id = index_spans(tracer.spans)
+        lineages = [lineage_of(s, by_id) for s in scale_spans]
+        lineage_complete = bool(lineages) and all(w["complete"] for w in lineages)
+        all_recovered = schedule.all_recovered()
+        mttr_max = max(mttrs) if mttrs else None
+        return {
+            "scenario": "recovery_drill",
+            "mode": "virtual",
+            "metric": "recovery_drill_mttr_max",
+            "value": round(mttr_max, 1) if mttr_max is not None else None,
+            "unit": "s",
+            "components": list(components),
+            "settled_replicas": settled,
+            "faults": reports,
+            "all_recovered": all_recovered,
+            "spurious_scale_events_during_replay": spurious,
+            "mttr_max_s": round(mttr_max, 1) if mttr_max is not None else None,
+            "replay_gap_max_s": round(max(gaps), 1) if gaps else 0.0,
+            "first_good_sync_max_s": round(max(syncs), 1) if syncs else None,
+            "restarts": [
+                {k: v for k, v in entry.items()} for entry in pipe.restart_log
+            ],
+            "final_replicas": pipe.replicas(),
+            "final_running": pipe.running(),
+            "scale_events": len(pipe.scale_history),
+            "scale_event_lineages": len(lineages),
+            "lineage_complete": lineage_complete,
+            "trace_spans": len(tracer.spans),
+            "ok": all_recovered and spurious == 0 and lineage_complete,
+        }
+
+
+def render_drill_report(result: dict) -> str:
+    """Human-readable drill summary for the ``simulate drill`` CLI."""
+    lines = [
+        f"recovery drill: components={','.join(result['components'])} "
+        f"settled={result['settled_replicas']} replicas",
+        f"{'fault':<28} {'mttr':>6} {'replay_gap':>10} "
+        f"{'first_sync':>10} {'recovered':>9}",
+    ]
+    for f in result["faults"]:
+        def fmt(x):
+            return "-" if x is None else f"{x:g}"
+
+        lines.append(
+            f"{f['fault']:<28} {fmt(f['mttr']):>6} {fmt(f['replay_gap']):>10} "
+            f"{fmt(f['time_to_first_good_sync']):>10} "
+            f"{str(f['recovered']):>9}"
+        )
+    lines.append(
+        f"spurious scale events during replay: "
+        f"{result['spurious_scale_events_during_replay']}  "
+        f"scale-event lineages complete: {result['lineage_complete']} "
+        f"({result['scale_event_lineages']})  "
+        f"final replicas: {result['final_replicas']}"
+    )
+    lines.append(f"verdict: {'PASS' if result['ok'] else 'FAIL'}")
+    return "\n".join(lines)

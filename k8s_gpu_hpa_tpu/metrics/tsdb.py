@@ -26,6 +26,13 @@ watches), and series ended by a staleness marker are garbage-collected once
 the marker ages out of the lookback window.  The read-capture lineage
 chokepoint is untouched: ``instant_vector`` remains the one function every
 read goes through, so capture sees exactly the points any query path returns.
+
+Durability (ISSUE 4): constructed with a ``WriteAheadLog`` (metrics/wal.py),
+every accepted append (staleness markers included) is logged before the call
+returns, a snapshot is cut every ``snapshot_every`` logged records, and
+``TimeSeriesDB.recover(wal)`` rebuilds the full store — series, inverted
+index, version counters, pending-staleness map, point origins — from the
+snapshot plus a tail replay that tolerates a torn final record.
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ from __future__ import annotations
 import math
 import random
 import time
+import zlib
 from bisect import bisect_left, bisect_right
 from dataclasses import dataclass, field
 from typing import Callable
@@ -110,6 +118,8 @@ class TimeSeriesDB:
         clock: Clock | None = None,
         lookback: float = 300.0,
         retention: float | None = None,
+        wal=None,
+        snapshot_every: int = 8192,
     ):
         self.clock = clock or SystemClock()
         self.lookback = lookback
@@ -136,6 +146,17 @@ class TimeSeriesDB:
         self._appends_since_gc = 0
         #: active read-capture sink (see begin_capture), else None
         self._capture: list[tuple[str, LabelSet, float, float, int | None]] | None = None
+        #: metrics.wal.WriteAheadLog, or None for the memory-only default;
+        #: every accepted append is logged, and a snapshot is cut every
+        #: ``snapshot_every`` logged records (bounding restart replay)
+        self.wal = wal
+        self.snapshot_every = snapshot_every
+        self._wal_records_since_snapshot = 0
+        #: True while ``recover`` replays the WAL tail: suspends re-logging
+        self._replaying = False
+        #: stats of the recovery that built this instance (``recover``), or
+        #: None for a cold-started DB
+        self.last_recovery: dict | None = None
 
     def append(
         self,
@@ -189,6 +210,11 @@ class TimeSeriesDB:
         self._appends_since_gc += 1
         if self._appends_since_gc >= self.GC_EVERY:
             self.gc()
+        if self.wal is not None and not self._replaying:
+            self.wal.log_append(name, series.labels, value, ts, origin)
+            self._wal_records_since_snapshot += 1
+            if self._wal_records_since_snapshot >= self.snapshot_every:
+                self.snapshot()
 
     def gc(self) -> int:
         """Drop series whose staleness marker has aged out of the lookback
@@ -225,6 +251,132 @@ class TimeSeriesDB:
                 del self._data[name]
             dropped += 1
         return dropped
+
+    # ---- durability (WAL snapshot + recovery) ------------------------------
+
+    def snapshot(self) -> None:
+        """Cut a full-state snapshot into the WAL and truncate the segments
+        it subsumes.  Captures everything a restart needs byte-for-byte:
+        retained points WITH their origin span ids (so lineage survives the
+        restart boundary), the per-name version counters (so incremental rule
+        eval's dirty-bit comparisons stay semantically exact), and the
+        pending-staleness map (so marker GC resumes where it left off).
+        NaN points (staleness markers) are encoded as ``null`` values —
+        the snapshot never relies on JSON's non-standard NaN literal."""
+        if self.wal is None:
+            return
+        series_out = []
+        for name, by_name in self._data.items():
+            for series in by_name.values():
+                series_out.append(
+                    {
+                        "name": name,
+                        "labels": list(series.labels),
+                        "points": [
+                            [ts, None if v != v else v, origin]
+                            for ts, v, origin in series.points
+                        ],
+                    }
+                )
+        payload = {
+            "at": self.clock.now(),
+            "lookback": self.lookback,
+            "retention": self.retention,
+            "series": series_out,
+            "versions": dict(self._versions),
+            "stale_pending": [
+                [name, list(labels), ts]
+                for (name, labels), ts in self._stale_pending.items()
+            ],
+        }
+        self.wal.write_snapshot(payload)
+        self._wal_records_since_snapshot = 0
+
+    @classmethod
+    def recover(
+        cls,
+        wal,
+        clock: Clock | None = None,
+        lookback: float = 300.0,
+        retention: float | None = None,
+        snapshot_every: int = 8192,
+    ) -> "TimeSeriesDB":
+        """Rebuild a TSDB from its durable state: restore the snapshot, then
+        replay the WAL tail in append order.  Replay goes through ``append``
+        itself so the inverted index, interning pool, version counters, trim,
+        and staleness bookkeeping are rebuilt by the same code that built
+        them the first time.  Equal-timestamp tails (snapshot cut mid-tick)
+        replay cleanly because ``append`` accepts ``ts == newest``; a record
+        that still lands out of order (e.g. after a ``wal_truncate`` tear) is
+        dropped, never fatal — recovery must always produce a serving DB.
+
+        The recovered instance takes ownership of ``wal`` and stamps
+        ``last_recovery`` with replay stats (the chaos RecoveryReports read
+        ``replay gap`` = recovery wall position minus newest replayed ts)."""
+        payload, tail = wal.read()
+        db = cls(
+            clock,
+            lookback=(payload or {}).get("lookback", lookback),
+            retention=(payload or {}).get("retention", retention),
+            snapshot_every=snapshot_every,
+        )
+        newest_ts = -math.inf
+        recovered_points = 0
+        if payload is not None:
+            for entry in payload["series"]:
+                name = entry["name"]
+                labels = tuple((k, v) for k, v in entry["labels"])
+                labels = db._intern.setdefault(labels, labels)
+                series = _Series(labels)
+                for ts, value, origin in entry["points"]:
+                    value = float("nan") if value is None else value
+                    series.points.append((ts, value, origin))
+                    series.ts.append(ts)
+                if not series.ts:
+                    continue
+                db._data.setdefault(name, {})[labels] = series
+                index = db._index.setdefault(name, {})
+                for pair in labels:
+                    index.setdefault(pair, {})[labels] = None
+                db._total_points += len(series.points)
+                recovered_points += len(series.points)
+                newest_ts = max(newest_ts, series.ts[-1])
+            db._versions.update(payload.get("versions", {}))
+            for name, labels, ts in payload.get("stale_pending", []):
+                labels = tuple((k, v) for k, v in labels)
+                labels = db._intern.setdefault(labels, labels)
+                db._stale_pending[(name, labels)] = ts
+        replayed = 0
+        dropped = 0
+        db._replaying = True
+        try:
+            for rec in tail:
+                labels = tuple((k, v) for k, v in rec["labels"])
+                value = float("nan") if rec["op"] == "stale" else rec["value"]
+                try:
+                    db.append(rec["name"], labels, value, rec["ts"], rec.get("origin"))
+                except ValueError:
+                    dropped += 1
+                    continue
+                replayed += 1
+                recovered_points += 1
+                newest_ts = max(newest_ts, rec["ts"])
+        finally:
+            db._replaying = False
+        db.wal = wal
+        now = db.clock.now()
+        db.last_recovery = {
+            "snapshot_restored": payload is not None,
+            "recovered_series": db.series_count(),
+            "recovered_points": recovered_points,
+            "replayed_records": replayed,
+            "dropped_records": dropped,
+            "newest_ts": None if newest_ts == -math.inf else newest_ts,
+            "replay_gap_seconds": (
+                max(0.0, now - newest_ts) if newest_ts != -math.inf else None
+            ),
+        }
+        return db
 
     # ---- read capture (metric lineage) ------------------------------------
     #
@@ -473,6 +625,23 @@ class Scraper:
         target.next_attempt_at = now + delay * (
             1.0 + self.backoff_jitter * self._rng.random()
         )
+
+    def stagger_after_recovery(self, spread: float | None = None) -> None:
+        """Thundering-herd guard for the first sweep after a TSDB restart:
+        every target's gap expired while the DB was down, so without this
+        the whole fleet (~1000 targets at scale) lands on one tick.  Each
+        target gets a deterministic slot inside ``spread`` (default 4
+        intervals) keyed by a CRC of its interned ``up`` label set — stable
+        across processes (unlike ``hash()``, which is salted per run), so
+        two recoveries of the same fleet stagger identically.  Never moves a
+        target earlier than an in-force backoff gate."""
+        if spread is None:
+            spread = 4.0 * self.interval
+        now = self.db.clock.now()
+        for target in self.targets:
+            labels = self._up_labels(target)
+            frac = (zlib.crc32(repr(labels).encode()) % 1024) / 1024.0
+            target.next_attempt_at = max(target.next_attempt_at, now + spread * frac)
 
     def scrape_once(self) -> int:
         """Scrape every due target.  A failing target gets staleness markers on

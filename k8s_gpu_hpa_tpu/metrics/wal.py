@@ -1,0 +1,210 @@
+"""Write-ahead log + snapshot store for the mini TSDB (durability layer).
+
+Real Prometheus survives a crash because every appended sample hits a WAL
+segment before it is acknowledged, and a periodic head snapshot bounds how
+much of that log a restart must replay.  This module gives the simulated
+TSDB the same two artifacts, sized for the harness:
+
+- **segments** (``wal-00000000.jsonl`` ...): append-ordered JSONL, one
+  record per accepted ``TimeSeriesDB.append`` — ``op: "append"`` for live
+  points, ``op: "stale"`` for staleness markers (kept as a distinct op so
+  NaN never has to round-trip through JSON).  Every record is flushed as
+  written, so a kill can tear at most the final line of the final segment.
+- **snapshot** (``snapshot.json``): the DB's full retained state (series
+  points with origins, rule version counters, pending-staleness map) plus
+  ``covered_through``, the index of the newest segment whose records the
+  snapshot subsumes.  Written atomically (tmp + ``os.replace``); segments
+  at or below ``covered_through`` are deleted only *after* the replace
+  lands, so a crash at any byte leaves either the old snapshot + all
+  segments or the new snapshot + the uncovered tail — both replayable.
+
+Recovery (``TimeSeriesDB.recover``) = restore the snapshot payload, then
+replay the tail segments in order.  An undecodable line is tolerated only
+where a kill can produce one: the final line of the final segment (dropped);
+anywhere else it is real corruption and raises ``WALCorruption``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+
+_SEGMENT_RE = re.compile(r"^wal-(\d{8})\.jsonl$")
+SNAPSHOT_NAME = "snapshot.json"
+
+
+class WALCorruption(Exception):
+    """A torn record somewhere a crash could not have produced one."""
+
+
+def _segment_name(index: int) -> str:
+    return f"wal-{index:08d}.jsonl"
+
+
+class WriteAheadLog:
+    """Append-ordered JSONL segments + atomic snapshot in one directory.
+
+    One instance owns the directory for one TSDB lifetime.  A *new* instance
+    over the same directory (the restart path) never appends to an existing
+    segment — it opens a fresh one past the highest on disk, so a torn tail
+    from the previous life stays final-line-of-its-segment and replayable.
+    """
+
+    def __init__(self, directory: str | os.PathLike, segment_max_records: int = 2048):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.segment_max_records = segment_max_records
+        existing = self._segment_indices()
+        #: index of the segment the next record lands in (always fresh on
+        #: construction; see class docstring)
+        self._seg_index = (existing[-1] + 1) if existing else 0
+        self._seg_records = 0
+        self._fh = None
+        #: lifetime records written through THIS instance (tests/telemetry)
+        self.records_written = 0
+
+    # ---- write path --------------------------------------------------------
+
+    def log_append(
+        self,
+        name: str,
+        labels: tuple[tuple[str, str], ...],
+        value: float,
+        ts: float,
+        origin: int | None = None,
+    ) -> None:
+        """Record one accepted append.  NaN (a staleness marker) is written
+        as ``op: "stale"`` with no value field."""
+        if value != value:  # NaN
+            rec: dict = {"op": "stale", "name": name, "labels": list(labels), "ts": ts}
+        else:
+            rec = {
+                "op": "append",
+                "name": name,
+                "labels": list(labels),
+                "value": value,
+                "ts": ts,
+            }
+        if origin is not None:
+            rec["origin"] = origin
+        self._write_line(json.dumps(rec, separators=(",", ":")))
+
+    def _write_line(self, line: str) -> None:
+        if self._fh is None or self._seg_records >= self.segment_max_records:
+            self._rotate()
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        self._seg_records += 1
+        self.records_written += 1
+
+    def _rotate(self) -> None:
+        """Seal the active segment (if any) and open the next one."""
+        if self._fh is not None:
+            self._fh.close()
+            self._seg_index += 1
+        self._fh = open(self.directory / _segment_name(self._seg_index), "a")
+        self._seg_records = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # ---- snapshot + truncation ---------------------------------------------
+
+    def write_snapshot(self, payload: dict) -> None:
+        """Atomically persist ``payload`` and truncate the segments it
+        subsumes.  Order matters for crash safety: seal the active segment,
+        replace the snapshot, THEN delete covered segments — a kill between
+        any two steps leaves a readable (snapshot, tail) pair."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        covered = self._segment_indices()
+        covered_through = covered[-1] if covered else self._seg_index
+        doc = {"covered_through": covered_through, "payload": payload}
+        tmp = self.directory / (SNAPSHOT_NAME + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, separators=(",", ":"), allow_nan=False)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.directory / SNAPSHOT_NAME)
+        for idx in covered:
+            (self.directory / _segment_name(idx)).unlink(missing_ok=True)
+        # next record starts the segment after everything the snapshot covers
+        self._seg_index = covered_through + 1
+        self._seg_records = 0
+
+    def truncate_tail(self, records: int = 64, tear: bool = False) -> int:
+        """Chaos hook (``wal_truncate``): destroy up to ``records`` parsed
+        lines from the end of the newest segment, optionally leaving a torn
+        partial record behind.  Returns how many complete records were lost."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        indices = self._segment_indices()
+        if not indices:
+            return 0
+        path = self.directory / _segment_name(indices[-1])
+        lines = path.read_text().splitlines()
+        lost = min(records, len(lines))
+        kept = lines[: len(lines) - lost]
+        body = "".join(line + "\n" for line in kept)
+        if tear:
+            body += '{"op":"append","name":"torn_mid_rec'
+        path.write_text(body)
+        return lost
+
+    # ---- read path ---------------------------------------------------------
+
+    def read(self) -> tuple[dict | None, list[dict]]:
+        """Return ``(snapshot_payload | None, tail_records)`` — everything a
+        recovery needs, in replay order.  Tolerates exactly one torn line:
+        the final line of the final segment."""
+        payload: dict | None = None
+        covered_through = -1
+        snap_path = self.directory / SNAPSHOT_NAME
+        if snap_path.exists():
+            try:
+                doc = json.loads(snap_path.read_text())
+                payload = doc["payload"]
+                covered_through = doc["covered_through"]
+            except (ValueError, KeyError) as exc:
+                raise WALCorruption(f"unreadable snapshot {snap_path}: {exc}") from exc
+        records: list[dict] = []
+        indices = [i for i in self._segment_indices() if i > covered_through]
+        for pos, idx in enumerate(indices):
+            path = self.directory / _segment_name(idx)
+            lines = path.read_text().splitlines()
+            last_segment = pos == len(indices) - 1
+            for lineno, line in enumerate(lines):
+                if not line.strip():
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError as exc:
+                    if last_segment and lineno == len(lines) - 1:
+                        # the one tear a kill can produce: drop it
+                        continue
+                    raise WALCorruption(
+                        f"torn record mid-log ({path.name}:{lineno + 1})"
+                    ) from exc
+        return payload, records
+
+    # ---- introspection -----------------------------------------------------
+
+    def _segment_indices(self) -> list[int]:
+        out = []
+        for entry in self.directory.iterdir():
+            m = _SEGMENT_RE.match(entry.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def segment_count(self) -> int:
+        return len(self._segment_indices())
+
+    def has_snapshot(self) -> bool:
+        return (self.directory / SNAPSHOT_NAME).exists()

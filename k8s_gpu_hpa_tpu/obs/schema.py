@@ -21,6 +21,8 @@ L5        hpa_sync           one HPA sync (always, scale or hold)
 L5        scale_event        one actual replica change
 —         workload_change    offered-load intensity step (harness-emitted)
 —         fault_window       one chaos fault's injected→recovered window
+—         component_restart  one control-plane crash+rebuild (WAL replay /
+                             checkpoint restore stats)
 ========  =================  ==============================================
 
 Causality flows through ``links`` (span ids of the spans whose data fed this
@@ -90,7 +92,28 @@ SPAN_SCHEMA: dict[str, dict] = {
         "(chaos/schedule.py); span start/end ARE the degraded window, so "
         "the RecoveryReport's MTTR is backed by the trace",
         "required": frozenset({"fault", "kind"}),
-        "optional": frozenset({"detected_at", "mttr"}),
+        "optional": frozenset(
+            {"detected_at", "mttr", "replay_gap", "time_to_first_good_sync"}
+        ),
+        "link_kinds": frozenset(),
+    },
+    "component_restart": {
+        "description": "one control-plane component torn down and rebuilt "
+        "from durable state (loop.restart_*): WAL replay stats for the "
+        "TSDB, checkpoint-restore flag for the HPA — the marker that keeps "
+        "a trace explicable across a restart boundary",
+        "required": frozenset({"component"}),
+        "optional": frozenset(
+            {
+                "snapshot_restored",
+                "recovered_series",
+                "recovered_points",
+                "replayed_records",
+                "dropped_records",
+                "replay_gap_seconds",
+                "checkpoint_restored",
+            }
+        ),
         "link_kinds": frozenset(),
     },
 }

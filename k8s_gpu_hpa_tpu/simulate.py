@@ -372,11 +372,45 @@ def main(args) -> int:
 
         result = run_fault_storm(pod_start_latency=args.pod_start)
         print(render_chaos_report(result))
-        ok = (
-            result["all_recovered"]
-            and result["spurious_scale_events_during_blackout"] == 0
+        # the chaos contract, machine-checked (same shape as the trace
+        # contract below): every fault's RecoveryReport must say recovered
+        # and no scale event may fire while the metrics are black
+        unrecovered = [f["fault"] for f in result["faults"] if not f["recovered"]]
+        spurious = result["spurious_scale_events_during_blackout"]
+        if unrecovered or spurious:
+            print(
+                "CHAOS CONTRACT VIOLATED: "
+                + (
+                    f"faults never recovered: {', '.join(unrecovered)}"
+                    if unrecovered
+                    else f"{spurious} scale event(s) during the blackout"
+                )
+            )
+            return 2
+        return 0
+
+    if args.scenario == "drill":
+        # recovery drill: kill each durable control-plane component mid-run
+        # (TSDB -> WAL replay, HPA -> checkpoint restore, adapter rewire,
+        # WAL-tail truncation) and require reconvergence with zero spurious
+        # scale events and complete lineage across every restart boundary
+        from k8s_gpu_hpa_tpu.control.scale_harness import (
+            DRILL_COMPONENTS,
+            render_drill_report,
+            run_recovery_drill,
         )
-        return 0 if ok else 2
+
+        raw = getattr(args, "components", None) or ",".join(DRILL_COMPONENTS)
+        components = tuple(c.strip() for c in raw.split(",") if c.strip())
+        try:
+            result = run_recovery_drill(
+                components=components, pod_start_latency=args.pod_start
+            )
+        except ValueError as e:
+            print(f"simulate: {e}")
+            return 2
+        print(render_drill_report(result))
+        return 0 if result["ok"] else 2
 
     if args.scenario == "trace":
         # the spike scenario, fully traced: decision timeline with per-scale-
@@ -476,13 +510,23 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(
         prog="python -m k8s_gpu_hpa_tpu.simulate",
         description="play a load scenario against a shipped HPA manifest "
-        "(virtual time); 'chaos' runs the canned fault storm",
+        "(virtual time); 'chaos' runs the canned fault storm, 'drill' the "
+        "crash/restart recovery drill",
     )
     parser.add_argument(
         "scenario",
         nargs="?",
         default="spike",
-        choices=["spike", "ramp", "flap", "outage", "crash", "chaos", "trace"],
+        choices=[
+            "spike",
+            "ramp",
+            "flap",
+            "outage",
+            "crash",
+            "chaos",
+            "trace",
+            "drill",
+        ],
     )
     parser.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")
     parser.add_argument("--duration", type=float, default=420.0)
@@ -492,5 +536,11 @@ if __name__ == "__main__":
         "--trace-out",
         default="trace.jsonl",
         help="JSONL span export path for the 'trace' scenario",
+    )
+    parser.add_argument(
+        "--components",
+        default=None,
+        help="comma list of components the 'drill' scenario restarts "
+        "(tsdb,hpa,adapter,wal); default all",
     )
     sys.exit(main(parser.parse_args()))

@@ -172,6 +172,15 @@ def test_time_budget_completes_unattended_with_labeled_skips():
     for key in ("speedup", "peak_retained_points", "query_p95_ms"):
         assert key in sim_scale, f"sim_scale rung missing {key!r}"
     assert sim_scale["meets_floor"] is True
+    # recovery_drill rung contract: every bench run reports how long the
+    # control plane was degraded (MTTR) and how much replayed state lagged
+    # (replay gap) when its components are killed and rebuilt mid-run
+    drill = final["rungs"]["recovery_drill"]
+    for key in ("mttr_max_s", "replay_gap_max_s", "first_good_sync_max_s"):
+        assert key in drill, f"recovery_drill rung missing {key!r}"
+    assert drill["all_recovered"] is True
+    assert drill["spurious_scale_events_during_replay"] == 0
+    assert drill["ok"] is True
     assert [c["pod_start_s"] for c in final["pod_start_sensitivity"]] == [
         12.0,
         30.0,

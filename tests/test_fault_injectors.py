@@ -1,0 +1,178 @@
+"""Injector hygiene, parametrized over the WHOLE fault registry.
+
+Every kind in ``FAULT_KINDS`` gets the same treatment: inject its natural
+spec against a durable pipeline (WAL + checkpoint store attached, so the
+restart kinds have something to recover from), clear it TWICE (clear must
+be idempotent), and prove the pipeline keeps ticking afterwards.  The
+parametrization is auto-covering — registering a new fault kind without a
+natural spec here fails the suite, and ``tools/lint_faults.py`` separately
+fails if a kind has no test referencing it at all.
+
+Overlap safety gets its own tests: two scrape-path faults stacked on one
+target must restore the pristine fetch whichever order their windows close.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, FaultSpec
+from k8s_gpu_hpa_tpu.control.checkpoint import InMemoryCheckpointStore
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.metrics.wal import WriteAheadLog
+
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def make_durable_pipeline(tmp_path):
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[("tpu-node-0", 4), ("tpu-node-1", 4)],
+        pod_start_latency=12.0,
+    )
+    state = {"load": 90.0}
+    dep = SimDeployment(
+        cluster,
+        "tpu-test",
+        "tpu-test",
+        load_fn=lambda t: state["load"],
+        load_mode="shared",
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(
+        cluster,
+        dep,
+        target_value=40.0,
+        max_replicas=4,
+        wal=WriteAheadLog(tmp_path / "wal", segment_max_records=256),
+        checkpoint_store=InMemoryCheckpointStore(),
+    )
+    pipe.start()
+    clock.advance(60.0)  # settle: running pods, WAL records, checkpoints
+    return clock, pipe, state
+
+
+# the "natural" FaultSpec kwargs per kind — what a schedule would declare
+NATURAL_SPECS: dict[str, dict] = {
+    "exporter_outage": dict(duration=10.0),
+    "frozen_samples": dict(duration=10.0),
+    "slow_scrape": dict(duration=10.0),
+    "scrape_blackout": dict(duration=10.0),
+    "node_preempt": dict(duration=20.0),
+    "node_drain": dict(duration=20.0),
+    "pod_crash": dict(),
+    "crashloop": dict(duration=10.0),
+    "adapter_blackout": dict(duration=10.0),
+    "tsdb_restart": dict(),
+    "hpa_restart": dict(),
+    "adapter_restart": dict(),
+    "wal_truncate": dict(params={"records": 8}),
+}
+
+RESTART_KINDS = {"tsdb_restart", "hpa_restart", "adapter_restart", "wal_truncate"}
+
+
+def test_every_fault_kind_has_a_natural_spec():
+    """The auto-covering guarantee: a new registry entry without a row here
+    is a test failure, not a silent coverage gap."""
+    assert set(NATURAL_SPECS) == set(FAULT_KINDS)
+
+
+@pytest.mark.parametrize("kind", sorted(FAULT_KINDS))
+def test_inject_clear_twice_pipeline_survives(tmp_path, kind):
+    clock, pipe, state = make_durable_pipeline(tmp_path)
+    spec = FaultSpec(kind=kind, at=0.0, **NATURAL_SPECS[kind])
+    clear = FAULT_KINDS[kind](pipe, spec)
+    clock.advance(max(spec.duration, 5.0))
+    clear()
+    clear()  # idempotent: the second call must be a no-op, not a crash
+    clock.advance(90.0)  # past backoff gates, pod restarts, HPA syncs
+
+    # the loop is alive and healthy again after the fault cleared
+    assert pipe.running() == pipe.replicas() >= 1
+    assert pipe.db.latest("up", {"target": "exporter/tpu-node-1"}) == 1.0
+    # no fault left a wrapped fetch behind
+    assert all(
+        getattr(t, "_fault_depth", 0) == 0 for t in pipe.scraper.targets
+    )
+    if kind in RESTART_KINDS:
+        assert pipe.restart_log, "restart kind logged no restart"
+        assert pipe.restart_log[-1]["component"] in ("tsdb", "hpa", "adapter")
+    if kind == "hpa_restart":
+        assert pipe.hpa.restored_from_checkpoint is True
+    if kind in ("tsdb_restart", "wal_truncate"):
+        # consumers were rewired onto the recovered DB
+        assert pipe.scraper.db is pipe.db
+        assert pipe.evaluator.db is pipe.db
+        assert pipe.adapter.db is pipe.db
+
+
+def test_restart_tsdb_from_wal_keeps_points_cold_loses_them(tmp_path):
+    clock, pipe, state = make_durable_pipeline(tmp_path)
+    before = pipe.db.total_points()
+    assert before > 0
+    info = pipe.restart_tsdb()
+    assert info["component"] == "tsdb"
+    assert pipe.db.total_points() == before
+    assert pipe.db.last_recovery["replayed_records"] > 0
+
+    cold = pipe.restart_tsdb(from_wal=False)
+    assert cold["recovered_points"] == 0
+    assert pipe.db.total_points() == 0  # the pre-durability failure mode
+
+
+def test_wal_truncate_without_wal_is_rejected():
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("tpu-node-0", 4)], pod_start_latency=12.0)
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", load_fn=lambda t: 50.0, load_mode="shared"
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(cluster, dep)  # no WAL attached
+    pipe.start()
+    clock.advance(30.0)
+    with pytest.raises(ValueError, match="no WAL"):
+        FAULT_KINDS["wal_truncate"](pipe, FaultSpec("wal_truncate", 0.0))
+
+
+@pytest.mark.parametrize("close_order", ["fifo", "lifo"])
+def test_overlapping_scrape_faults_restore_pristine_fetch(tmp_path, close_order):
+    clock, pipe, state = make_durable_pipeline(tmp_path)
+    target = next(
+        t for t in pipe.scraper.targets if t.name == "exporter/tpu-node-0"
+    )
+    pristine = target.fetch
+    clear_outage = FAULT_KINDS["exporter_outage"](
+        pipe, FaultSpec("exporter_outage", 0.0, 10.0, target="exporter/tpu-node-0")
+    )
+    clear_slow = FAULT_KINDS["slow_scrape"](
+        pipe, FaultSpec("slow_scrape", 0.0, 20.0, target="exporter/tpu-node-0")
+    )
+    first, second = (
+        (clear_outage, clear_slow)
+        if close_order == "fifo"
+        else (clear_slow, clear_outage)
+    )
+    first()
+    assert target.fetch is not pristine, "still one fault in force"
+    second()
+    assert target.fetch is pristine, f"{close_order}: pristine fetch not restored"
+    assert target._fault_depth == 0
+
+
+def test_overlapping_adapter_blackout_and_restart(tmp_path):
+    """An adapter_restart landing INSIDE a blackout window: the blackout's
+    clear must not resurrect the torn-down adapter it captured at inject."""
+    clock, pipe, state = make_durable_pipeline(tmp_path)
+    clear_blackout = FAULT_KINDS["adapter_blackout"](
+        pipe, FaultSpec("adapter_blackout", 0.0, 30.0)
+    )
+    FAULT_KINDS["adapter_restart"](pipe, FaultSpec("adapter_restart", 0.0))
+    restarted = pipe.hpa.adapter
+    clear_blackout()
+    assert pipe.hpa.adapter is restarted, "blackout clear undid the restart"
+    assert pipe.hpa.adapter is pipe.adapter

@@ -222,7 +222,12 @@ def _pods_fixture(pod_values: dict[str, float], listed: list[str]):
     return clock, adapter
 
 
-def test_pods_metric_averages_over_reporting_pods():
+def test_pods_metric_missing_pods_dampen_scale_up():
+    """k8s conservative semantics: the raw average over reporting pods says
+    scale UP, so the missing pod is assumed to consume 0 — the adjusted
+    average (sum / ALL listed pods) drives a smaller proposal."""
+    import pytest
+
     clock, adapter = _pods_fixture({"a": 10.0, "b": 30.0}, ["a", "b", "c"])
     target = FakeTarget(replicas=2)
     hpa = HPAController(
@@ -234,9 +239,52 @@ def test_pods_metric_averages_over_reporting_pods():
         pod_lister=FakePodLister(["a", "b", "c"]),  # c has no fresh series
     )
     hpa.sync_once()
-    # avg over reporting pods = 20, target 10 -> ratio 2 -> 2*2=4
-    assert target.replicas == 4
+    # raw avg over reporting = 20 (ratio 2, up) -> missing counted at 0:
+    # adjusted = 40/3 = 13.33, ratio 1.33 -> ceil(2 * 1.33) = 3, not 4
+    assert target.replicas == 3
+    assert hpa.status.last_metric_values[
+        "pods/tpu_test_hbm_used_bytes"
+    ] == pytest.approx(40.0 / 3.0)
+    assert "missing" in hpa.status.last_reason
+
+
+def test_pods_metric_missing_pods_dampen_scale_down():
+    """Scale-DOWN direction: missing pods are assumed to consume the full
+    target, pulling the adjusted average back UP toward a hold."""
+    clock, adapter = _pods_fixture({"a": 2.0, "b": 4.0}, ["a", "b", "c"])
+    target = FakeTarget(replicas=3)
+    hpa = HPAController(
+        target=target,
+        metrics=[PodsMetricSpec("tpu_test_hbm_used_bytes", 10.0)],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+        pod_lister=FakePodLister(["a", "b", "c"]),
+    )
+    hpa.sync_once()
+    # raw avg = 3 (ratio 0.3, down) -> missing counted at target:
+    # adjusted = (6 + 10)/3 = 5.33, ratio 0.53 -> ceil(3 * 0.53) = 2, not 1
+    assert target.replicas == 2
+    assert "missing" in hpa.status.last_reason
+
+
+def test_pods_metric_no_missing_pods_unchanged():
+    """With every listed pod reporting, the classic average applies and no
+    conservative note is attached."""
+    clock, adapter = _pods_fixture({"a": 10.0, "b": 30.0}, ["a", "b"])
+    target = FakeTarget(replicas=2)
+    hpa = HPAController(
+        target=target,
+        metrics=[PodsMetricSpec("tpu_test_hbm_used_bytes", 10.0)],
+        adapter=adapter,
+        clock=clock,
+        max_replicas=8,
+        pod_lister=FakePodLister(["a", "b"]),
+    )
+    hpa.sync_once()
+    assert target.replicas == 4  # avg 20, target 10 -> ratio 2 -> 4
     assert hpa.status.last_metric_values["pods/tpu_test_hbm_used_bytes"] == 20.0
+    assert "missing" not in hpa.status.last_reason
 
 
 def test_pods_metric_unavailable_holds():

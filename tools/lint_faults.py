@@ -1,0 +1,68 @@
+"""Lint the chaos fault registry: every kind must be wired AND exercised.
+
+The registry (chaos/faults.py FAULT_KINDS) is the chaos subsystem's public
+contract — the schedule validates FaultSpec.kind against it, the storm and
+the recovery drill draw from it, and tests parametrize over it.  A kind can
+silently rot in three ways this lint closes:
+
+- **no injector**: the registry maps the kind to something non-callable
+  (or None) — a FaultSpec would validate but injection would crash;
+- **undocumented**: the kind is missing from the module docstring's table,
+  so the one place humans look for "what can I break?" lies by omission;
+- **untested**: no file under tests/ mentions the kind string, so its
+  injector (and clear) can regress without a single failure.
+
+Usage:
+    python tools/lint_faults.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import k8s_gpu_hpa_tpu.chaos.faults as faults_mod  # noqa: E402
+from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS  # noqa: E402
+
+
+def lint_fault_kinds(tests_dir: Path | None = None) -> list[str]:
+    """Every registry violation, as human-readable strings."""
+    tests_dir = tests_dir or (REPO / "tests")
+    errors: list[str] = []
+    docstring = faults_mod.__doc__ or ""
+    test_blobs = {
+        p.name: p.read_text() for p in sorted(tests_dir.glob("test_*.py"))
+    }
+    for kind, injector in sorted(FAULT_KINDS.items()):
+        if not callable(injector):
+            errors.append(f"{kind}: registry entry is not callable ({injector!r})")
+        if f"``{kind}``" not in docstring:
+            errors.append(
+                f"{kind}: not documented in the chaos/faults.py docstring table"
+            )
+        if not any(kind in blob for blob in test_blobs.values()):
+            errors.append(f"{kind}: no file under tests/ references it")
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    if argv:
+        print(__doc__.split("Usage:")[1].strip(), file=sys.stderr)
+        return 2
+    errors = lint_fault_kinds()
+    for err in errors:
+        print(f"lint_faults: {err}")
+    if errors:
+        return 1
+    print(
+        f"lint_faults ok: {len(FAULT_KINDS)} fault kinds all have an "
+        "injector, a docstring row, and test coverage"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

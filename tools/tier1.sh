@@ -16,4 +16,11 @@ python tools/lint_trace_schema.py --selfcheck || exit 1
 # absorbs CI-host noise; the point bound is deterministic, observed 14815)
 python tools/profile_sim.py --targets 100 --horizon 600 \
   --assert-min-speedup 20 --assert-max-points 25000 || exit 1
+# fault-registry lint: every chaos fault kind must have an injector, a
+# docstring row, and at least one test referencing it
+python tools/lint_faults.py || exit 1
+# recovery-drill smoke (small sizing: one component): kill the TSDB mid-run,
+# replay its WAL, and require reconvergence with zero spurious scale events
+# and lineage-complete traces — exit 0 IS the durability contract
+python -m k8s_gpu_hpa_tpu.simulate drill --components tsdb || exit 1
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
