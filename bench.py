@@ -1663,6 +1663,27 @@ def run_rung_signal_latency() -> dict:
     }
 
 
+def run_rung_slo_burn() -> dict:
+    """SLO burn-rate alerting rung (obs/slo.py): the Workbook multi-window
+    alert pairs scored against chaos both ways — a clean staircase window
+    where any SLO alert firing is a false positive, and an identical window
+    with a total scrape blackout where the fast (page) scrape-success alert
+    must fire.  Reports detection latency (injection -> first firing sample)
+    for the fast and slow alerts.  Virtual time: deterministic run-to-run."""
+    from k8s_gpu_hpa_tpu.simulate import run_slo_check
+
+    result = run_slo_check(pod_start_latency=BASE_POD_START_LATENCY)
+    return {
+        "mode": "virtual",
+        "metric": "SLO burn-rate detection (s, blackout -> alert firing)",
+        "clean_false_positives": result["clean_false_positives"],
+        "fault_first_fired": result["fault_first_fired"],
+        "fast_detection_s": result["fast_detection_s"],
+        "slow_detection_s": result["slow_detection_s"],
+        "ok": result["ok"],
+    }
+
+
 def run_rung_recovery_drill() -> dict:
     """Control-plane crash/restart rung (control/scale_harness.py): a fully
     durable pipeline (TSDB WAL + HPA checkpoint, traced) holds steady at 3
@@ -2115,6 +2136,7 @@ def main() -> None:
             ("4_multihost_quantum", run_rung_multihost_quantum),
             ("chaos_storm", run_rung_chaos),
             ("signal_latency", run_rung_signal_latency),
+            ("slo_burn", run_rung_slo_burn),
             ("sim_scale", run_rung_sim_scale),
             ("recovery_drill", run_rung_recovery_drill),
         ):

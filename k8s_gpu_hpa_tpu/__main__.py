@@ -205,6 +205,7 @@ def main(argv: list[str] | None = None) -> int:
             "chaos",
             "trace",
             "drill",
+            "slo",
         ],
         default="spike",
     )

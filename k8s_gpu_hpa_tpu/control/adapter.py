@@ -17,6 +17,7 @@ cuda-test-prometheusrule.yaml:14-16), and serve instant values on the
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
@@ -79,6 +80,7 @@ class CustomMetricsAdapter:
         rules: list[AdapterRule],
         external_rules: list[ExternalRule] | None = None,
         tracer=None,
+        selfmetrics=None,
     ):
         self.db = db
         self.rules = {r.metric_name: r for r in rules}
@@ -86,6 +88,9 @@ class CustomMetricsAdapter:
         #: obs.Tracer: every metric query emits an ``adapter_query`` span
         #: linked to the rule_eval/scrape spans that wrote the points it read
         self.tracer = tracer
+        #: obs.PipelineSelfMetrics: query-duration histogram with the
+        #: adapter_query span as each observation's exemplar
+        self.selfmetrics = selfmetrics
 
     def _traced(self, api: str, metric: str, query, found):
         """Run ``query`` under an ``adapter_query`` span whose links are the
@@ -95,6 +100,7 @@ class CustomMetricsAdapter:
             return query()
         span = self.tracer.open("adapter_query", {"api": api, "metric": metric})
         self.db.begin_capture()
+        wall_start = time.perf_counter()
         ok = False
         result = None
         try:
@@ -102,12 +108,15 @@ class CustomMetricsAdapter:
             ok = found(result)
             return result
         finally:
+            duration = time.perf_counter() - wall_start
             reads = self.db.end_capture()
             links = tuple({r[4] for r in reads if r[4] is not None})
-            attrs: dict = {"found": ok}
+            attrs: dict = {"found": ok, "duration_seconds": duration}
             if ok and isinstance(result, (int, float)):
                 attrs["value"] = float(result)
             self.tracer.close(span, links, **attrs)
+            if self.selfmetrics is not None:
+                self.selfmetrics.observe_adapter_query(duration, span.span_id)
 
     def list_metrics(self) -> list[str]:
         """API discovery: the set of metric names the adapter exposes — what the

@@ -392,6 +392,9 @@ class HPAController:
         #: clock time of the last sync that computed a valid replica count
         #: (ScalingActive true) — the recovery drill's time-to-first-good-sync
         self.last_good_sync_at: float | None = None
+        #: span id of the newest workload_change already credited with a
+        #: propagation observation (one observation per change)
+        self._propagation_seen: int | None = None
         #: control.checkpoint.CheckpointStore: sync-to-sync durable state.
         #: Restored here, at construction, so a restarted controller honors
         #: in-flight stabilization windows instead of flapping.
@@ -650,7 +653,11 @@ class HPAController:
             children = self.tracer.pop_scope() if self.tracer is not None else ()
         duration = time.perf_counter() - wall_start
         if self.selfmetrics is not None:
-            self.selfmetrics.observe_sync(duration, status.last_reason)
+            self.selfmetrics.observe_sync(
+                duration,
+                status.last_reason,
+                None if span is None else span.span_id,
+            )
         if span is not None:
             self.tracer.close(
                 span,
@@ -662,13 +669,32 @@ class HPAController:
             )
             after = self.target.replicas
             if after != before:
-                self.tracer.emit(
+                event = self.tracer.emit(
                     "scale_event",
                     {"from_replicas": before, "to_replicas": after},
                     links=(span.span_id,),
                 )
+                self._observe_propagation(event)
         self._save_checkpoint()
         return status
+
+    def _observe_propagation(self, event) -> None:
+        """The first scale event after each workload_change observes the
+        end-to-end signal-propagation latency (virtual seconds) into the
+        self-metrics histogram, exemplared with the scale_event span — the
+        live counterpart of the offline pairing in
+        obs/latency.propagation_report."""
+        if self.selfmetrics is None:
+            return
+        changes = self.tracer.spans_of("workload_change")
+        if not changes:
+            return
+        change = changes[-1]
+        if change.span_id == self._propagation_seen:
+            return
+        self._propagation_seen = change.span_id
+        latency = max(0.0, event.start - change.start)
+        self.selfmetrics.observe_propagation(latency, event.span_id)
 
     def _sync_inner(self) -> HPAStatus:
         current = self.target.replicas

@@ -92,7 +92,7 @@ class AutoscalingPipeline:
             from k8s_gpu_hpa_tpu.obs import SELF_TARGET_NAME, PipelineSelfMetrics
 
             cluster.tracer = tracer
-            self.selfmetrics = PipelineSelfMetrics()
+            self.selfmetrics = PipelineSelfMetrics(clock=clock)
 
         self.db = TimeSeriesDB(clock, wal=wal)
         self.scraper = Scraper(
@@ -145,10 +145,27 @@ class AutoscalingPipeline:
                 record=record,
             )
         rules = [primary] + (extra_rules or [])
+        # SLO wiring rides with observability: the recorders fold scrape
+        # success and signal propagation into error-budget counters each
+        # tick, and the Workbook burn-rate pairs alert on them.  Untraced
+        # pipelines (tracer=None, e.g. the fleet-scale harness) skip it —
+        # the propagation SLO needs selfmetrics anyway, and the recorders'
+        # per-tick reads/appends must not tax the perf-gated paths.
+        slo_recorders: list = []
+        alerts = None
+        if self.selfmetrics is not None:
+            from k8s_gpu_hpa_tpu.obs.slo import (
+                shipped_slo_alerts,
+                shipped_slo_recorders,
+            )
+
+            slo_recorders = shipped_slo_recorders()
+            alerts = shipped_slo_alerts()
         self.evaluator = RuleEvaluator(
             self.db,
-            rules,
+            rules + slo_recorders,
             interval=self.intervals.rule_eval,
+            alerts=alerts,
             tracer=tracer,
             selfmetrics=self.selfmetrics,
         )
@@ -176,6 +193,7 @@ class AutoscalingPipeline:
             ]
             + (extra_adapter_rules or []),
             tracer=tracer,
+            selfmetrics=self.selfmetrics,
         )
 
         ref = ObjectReference(object_kind, deployment.name, deployment.namespace)
@@ -329,6 +347,7 @@ class AutoscalingPipeline:
             list(old.rules.values()),
             external_rules=list(old.external_rules.values()),
             tracer=old.tracer,
+            selfmetrics=old.selfmetrics,
         )
         self.hpa.adapter = self.adapter
         return self._log_restart("adapter", {})

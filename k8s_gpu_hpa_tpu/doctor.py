@@ -34,7 +34,11 @@ from typing import Callable
 
 from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
 from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS, CORE_CHIP_METRICS
-from k8s_gpu_hpa_tpu.obs.selfmetrics import SELF_METRIC_NAMES, SELF_TARGET_NAME
+from k8s_gpu_hpa_tpu.obs.selfmetrics import (
+    SELF_HISTOGRAM_NAMES,
+    SELF_METRIC_NAMES,
+    SELF_TARGET_NAME,
+)
 
 #: one instant query covering every self-metric family (obs/selfmetrics.py)
 SELF_METRICS_QUERY = '{__name__=~"%s"}' % "|".join(SELF_METRIC_NAMES)
@@ -192,6 +196,68 @@ def check_self_metrics(payload: str) -> str:
     return f"all {len(SELF_METRIC_NAMES)} self-metric families fresh ({len(results)} series)"
 
 
+def check_histograms(text: str) -> str:
+    """Histogram conformance: every self-histogram family in the raw
+    exposition obeys the OpenMetrics cumulative-bucket contract.  Per label
+    set: bucket counts non-decreasing in ``le`` order, a ``+Inf`` bucket
+    present and exactly equal to ``_count`` (cumulative means the last
+    bucket IS the count), and ``_sum`` consistent (non-negative for these
+    duration histograms, and zero while the count is zero).  A violation
+    here means quantile estimates and the SLO's bucket-derived good-event
+    counters are garbage even though every individual series looks healthy
+    — exactly the class of break a per-series freshness probe can't see.
+    ``text`` is the exposition body of the ``pipeline-self`` target."""
+    fams = {f.name: f for f in parse_text(text)}
+    checked = 0
+    for name in SELF_HISTOGRAM_NAMES:
+        fam = fams.get(name)
+        if fam is None:
+            raise AssertionError(f"histogram family {name} missing from exposition")
+        # group the suffixed series by their non-le label sets
+        groups: dict[tuple, dict] = {}
+        for s in fam.samples:
+            key = tuple(sorted((k, v) for k, v in s.labels if k != "le"))
+            g = groups.setdefault(key, {"buckets": [], "sum": None, "count": None})
+            if s.suffix == "_bucket":
+                le = s.label("le")
+                if le is None:
+                    raise AssertionError(f"{name}_bucket sample lacks the le label")
+                g["buckets"].append((float(le), s.value))
+            elif s.suffix == "_sum":
+                g["sum"] = s.value
+            elif s.suffix == "_count":
+                g["count"] = s.value
+        if not groups:
+            raise AssertionError(f"histogram family {name} has no samples")
+        for key, g in groups.items():
+            where = f"{name}{dict(key) if key else ''}"
+            if g["sum"] is None or g["count"] is None:
+                raise AssertionError(f"{where}: _sum/_count series missing")
+            buckets = sorted(g["buckets"])
+            if not buckets or buckets[-1][0] != float("inf"):
+                raise AssertionError(f"{where}: no +Inf bucket")
+            counts = [c for _, c in buckets]
+            if any(later < earlier for earlier, later in zip(counts, counts[1:])):
+                raise AssertionError(
+                    f"{where}: bucket counts not cumulative "
+                    f"(non-decreasing in le): {counts}"
+                )
+            if counts[-1] != g["count"]:
+                raise AssertionError(
+                    f"{where}: +Inf bucket {counts[-1]:g} != _count {g['count']:g}"
+                )
+            if g["sum"] < 0 or (g["count"] == 0 and g["sum"] != 0):
+                raise AssertionError(
+                    f"{where}: _sum {g['sum']:g} inconsistent with "
+                    f"_count {g['count']:g}"
+                )
+            checked += 1
+    return (
+        f"{len(SELF_HISTOGRAM_NAMES)} histogram families conformant "
+        f"({checked} label sets)"
+    )
+
+
 def check_custom_metrics_api(payload: str, metric: str) -> str:
     """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
     doc = json.loads(payload)
@@ -283,6 +349,7 @@ def diagnose(
     operator_fetch: Callable[[], str] | None = None,
     up_fetch: Callable[[], str] | None = None,
     self_metrics_fetch: Callable[[], str] | None = None,
+    self_exposition_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -310,6 +377,13 @@ def diagnose(
             "pipeline self-metric families present and fresh",
             (lambda: check_self_metrics(self_metrics_fetch()))
             if self_metrics_fetch
+            else None,
+        ),
+        (
+            "L3 histogram conformance",
+            "self-histograms cumulative, +Inf == _count, _sum consistent",
+            (lambda: check_histograms(self_exposition_fetch()))
+            if self_exposition_fetch
             else None,
         ),
         (

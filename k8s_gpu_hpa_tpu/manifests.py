@@ -35,6 +35,7 @@ from k8s_gpu_hpa_tpu.metrics.schema import (
     TPU_HBM_USAGE,
     TPU_TENSORCORE_UTIL,
 )
+from k8s_gpu_hpa_tpu.obs.slo import shipped_slo_alerts
 
 # ---------------------------------------------------------------------------
 # The string contracts (each cited to the shipped manifest that carries it).
@@ -550,7 +551,8 @@ def prometheusrule_manifest(
         }
         for group_name, rules in (groups or shipped_rule_groups())
     ]
-    if alerts is None and groups is None:
+    shipped_defaults = alerts is None and groups is None
+    if shipped_defaults:
         alerts = shipped_alert_rules()
     if alerts:
         group_docs.append(
@@ -558,6 +560,14 @@ def prometheusrule_manifest(
                 "name": "tpu-pipeline-alerts",
                 "interval": RULE_INTERVAL,
                 "rules": [_alert_entry(a) for a in alerts],
+            }
+        )
+    if shipped_defaults:
+        group_docs.append(
+            {
+                "name": "tpu-slo-burn",
+                "interval": RULE_INTERVAL,
+                "rules": [_alert_entry(a) for a in shipped_slo_alerts()],
             }
         )
     return {

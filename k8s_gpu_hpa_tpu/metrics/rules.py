@@ -28,6 +28,7 @@ truth for both the tested semantics and the shipped manifest.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.schema import Sample, TPU_DUTY_CYCLE, TPU_TENSORCORE_UTIL
@@ -261,6 +262,163 @@ class Absent(Expr):
         return f"absent({self.child.promql()})"
 
 
+def bucket_quantile(buckets: list[tuple[float, float]], q: float) -> float | None:
+    """Classic Prometheus ``histogram_quantile`` interpolation over one
+    series' cumulative buckets.
+
+    ``buckets`` is [(le, cumulative_count), ...] including the +Inf bucket;
+    ``q`` in [0, 1].  Linear interpolation inside the bucket the rank lands
+    in, with 0 as the first bucket's lower edge; a rank landing in +Inf
+    returns the highest finite bound (Prometheus semantics — the histogram
+    cannot resolve beyond its last boundary).  None when the histogram is
+    empty or has no +Inf bucket."""
+    buckets = sorted(buckets)
+    if not buckets or buckets[-1][0] != math.inf:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, q))
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    for bound, count in buckets:
+        # count > 0 guard: q=0 (rank 0) must land in the first NON-empty
+        # bucket (the one holding the minimum), not bucket 0
+        if count >= rank and count > 0:
+            if bound == math.inf:
+                # beyond the last finite boundary: clamp (len >= 2 is
+                # guaranteed — Histogram always has a finite bound)
+                return buckets[-2][0] if len(buckets) > 1 else None
+            in_bucket = count - prev_count
+            if in_bucket <= 0:
+                return bound
+            return prev_bound + (bound - prev_bound) * (rank - prev_count) / in_bucket
+        prev_bound, prev_count = bound, count
+    return buckets[-2][0] if len(buckets) > 1 else None
+
+
+@dataclass
+class HistogramQuantile(Expr):
+    """``histogram_quantile(q, name_bucket{matchers})`` — per-series quantile
+    estimate from cumulative buckets.
+
+    Reads the ``_bucket`` series of a histogram family, groups by the label
+    set minus ``le``, and interpolates within the bucket the rank lands in
+    (``bucket_quantile``).  The estimate's error is bounded by the width of
+    that bucket — the property the tests assert against the exact
+    ``obs/latency.percentile`` reference."""
+
+    q: float  # quantile in [0, 1]
+    name: str  # base histogram name (no _bucket suffix)
+    matchers: dict[str, str] = field(default_factory=dict)
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        groups: dict[tuple[tuple[str, str], ...], list[tuple[float, float]]] = {}
+        for sample in db.instant_vector(self.name + "_bucket", self.matchers, at):
+            le = None
+            rest: list[tuple[str, str]] = []
+            for k, v in sample.labels:
+                if k == "le":
+                    le = v
+                else:
+                    rest.append((k, v))
+            if le is None:
+                continue
+            try:
+                bound = math.inf if le == "+Inf" else float(le)
+            except ValueError:
+                continue
+            groups.setdefault(tuple(rest), []).append((bound, sample.value))
+        out: Vector = []
+        for labels, buckets in groups.items():
+            value = bucket_quantile(buckets, self.q)
+            if value is not None:
+                out.append(Sample(value, labels))
+        return out
+
+    def input_names(self) -> frozenset[str]:
+        return frozenset((self.name + "_bucket",))
+
+    def promql(self) -> str:
+        inner = Select(self.name + "_bucket", dict(self.matchers))
+        q = self.q
+        rendered = str(int(q)) if q == int(q) else repr(q)
+        return f"histogram_quantile({rendered}, {inner.promql()})"
+
+
+def _fmt_window(seconds: float) -> str:
+    """PromQL range-duration rendering: 3600 -> ``1h``, 300 -> ``5m``."""
+    s = int(seconds)
+    if s % 3600 == 0:
+        return f"{s // 3600}h"
+    if s % 60 == 0:
+        return f"{s // 60}m"
+    return f"{s}s"
+
+
+@dataclass
+class BurnRate(Expr):
+    """SLO error-budget burn rate over a trailing window (SRE Workbook).
+
+    ``burn = ((total_inc - good_inc) / total_inc) / (1 - objective)``,
+    where the increases are counter deltas over ``window`` seconds read as
+    two instant queries (now and now - window) summed across matching
+    series.  Burn 1.0 spends the budget exactly at the SLO boundary; the
+    Workbook thresholds (14.4 fast, 6 slow) are multiples of that spend
+    rate.  Returns an EMPTY vector — so an alert on top cannot fire — when
+    the total counter is absent or did not move in the window (no traffic
+    means no evidence of burn), and clamps counter resets to zero."""
+
+    good_name: str
+    total_name: str
+    objective: float  # e.g. 0.99
+    window: float  # seconds
+    good_matchers: dict[str, str] = field(default_factory=dict)
+    total_matchers: dict[str, str] = field(default_factory=dict)
+
+    def _sum_at(
+        self, db: TimeSeriesDB, name: str, matchers: dict[str, str], at: float
+    ) -> float | None:
+        vec = db.instant_vector(name, matchers, at)
+        if not vec:
+            return None
+        return sum(s.value for s in vec)
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        at = db.clock.now() if at is None else at
+        total_now = self._sum_at(db, self.total_name, self.total_matchers, at)
+        if total_now is None:
+            return []
+        good_now = self._sum_at(db, self.good_name, self.good_matchers, at) or 0.0
+        then = at - self.window
+        # before the counters existed (run younger than the window) the
+        # trailing read is empty -> 0: the increase degrades to since-start
+        total_then = (
+            self._sum_at(db, self.total_name, self.total_matchers, then) or 0.0
+        )
+        good_then = self._sum_at(db, self.good_name, self.good_matchers, then) or 0.0
+        total_inc = max(0.0, total_now - total_then)  # reset clamp
+        if total_inc <= 0:
+            return []
+        good_inc = min(total_inc, max(0.0, good_now - good_then))
+        error_ratio = (total_inc - good_inc) / total_inc
+        burn = error_ratio / (1.0 - self.objective)
+        return [Sample(burn, ())]
+
+    def input_names(self) -> frozenset[str]:
+        return frozenset((self.good_name, self.total_name))
+
+    def promql(self) -> str:
+        w = _fmt_window(self.window)
+        good = Select(self.good_name, dict(self.good_matchers)).promql()
+        total = Select(self.total_name, dict(self.total_matchers)).promql()
+        budget = 1.0 - self.objective
+        return (
+            f"(1 - (increase({good}[{w}]) / increase({total}[{w}])))"
+            f" / {budget:g}"
+        )
+
+
 @dataclass
 class AlertRule:
     """One ``alert:`` rule with Prometheus ``for:`` semantics: the expr must
@@ -380,6 +538,7 @@ class RecordingRule:
         self.full_evals += 1
         span = tracer.open("rule_eval", {"rule": self.record}) if tracer else None
         origin = None if span is None else span.span_id
+        wall_start = 0.0 if selfmetrics is None else time.perf_counter()
         # capture is always on for a full eval: the read timestamps feed the
         # aging guard above (and lineage/self-metrics when wired)
         db.begin_capture()
@@ -408,8 +567,16 @@ class RecordingRule:
             self._last_oldest_read = None
             self._last_newest_read = None
         staleness = ts - self._last_newest_read if reads else None
-        if selfmetrics is not None and staleness is not None:
-            selfmetrics.observe_rule_eval(self.record, staleness)
+        if selfmetrics is not None:
+            duration = time.perf_counter() - wall_start
+            if staleness is not None:
+                selfmetrics.observe_rule_eval(
+                    self.record, staleness, duration=duration, span_id=origin
+                )
+            else:
+                selfmetrics.observe_rule_eval(
+                    self.record, float("nan"), duration=duration, span_id=origin
+                )
         if span is not None:
             links = tuple({r[4] for r in reads if r[4] is not None})
             attrs = {"samples_out": count}

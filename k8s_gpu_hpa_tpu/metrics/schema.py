@@ -46,6 +46,7 @@ pipeline's worst honesty bug.
 
 from __future__ import annotations
 
+import bisect
 from dataclasses import dataclass, field
 
 # Canonical metric names (the string contracts the whole pipeline pivots on —
@@ -81,11 +82,34 @@ CORE_CHIP_METRICS = (TPU_HBM_USAGE, TPU_HBM_TOTAL)
 
 
 @dataclass(frozen=True)
+class Exemplar:
+    """An OpenMetrics exemplar: the traced observation behind a bucket count.
+
+    Carries the trace/span ids from ``obs/trace.py`` so a tail bucket links
+    back to the exact decision timeline that produced it (the
+    metrics→traces bridge).  The tracer is single-process, so ``trace_id``
+    is the id of the span under which the observation happened — the same
+    id its whole lineage subtree hangs off."""
+
+    value: float
+    trace_id: int
+    span_id: int
+    ts: float | None = None
+
+
+@dataclass(frozen=True)
 class Sample:
-    """One exposition sample: value plus its label set."""
+    """One exposition sample: value plus its label set.
+
+    ``suffix`` supports compound families (histograms): the series name on
+    the wire is ``family.name + sample.suffix`` (``_bucket``/``_sum``/
+    ``_count``), while the family keeps its base name for TYPE/HELP.
+    ``exemplar`` rides along on ``_bucket`` samples only."""
 
     value: float
     labels: tuple[tuple[str, str], ...] = ()
+    suffix: str = ""
+    exemplar: Exemplar | None = None
 
     @staticmethod
     def make(value: float, **labels: str) -> "Sample":
@@ -109,6 +133,107 @@ class MetricFamily:
 
     def add(self, value: float, **labels: str) -> None:
         self.samples.append(Sample.make(value, **labels))
+
+
+#: Prometheus-style duration buckets for the pipeline's own wall-clock
+#: self-latencies (scrape/rule-eval/adapter/sync run sub-millisecond to
+#: tens of milliseconds in-process; the 1.0/2.5 tail catches a wedged joint).
+DEFAULT_DURATION_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+def format_le(bound: float) -> str:
+    """The canonical ``le`` label value for a bucket bound: integral bounds
+    collapse (``30`` not ``30.0``) and the overflow bucket is ``+Inf`` —
+    matching exposition._format_value so text round-trips are stable."""
+    if bound == float("inf"):
+        return "+Inf"
+    if bound == int(bound):
+        return str(int(bound))
+    return repr(bound)
+
+
+class Histogram:
+    """A cumulative-bucket histogram in the OpenMetrics layout.
+
+    Per label set it keeps cumulative bucket counts (one per finite bound
+    plus the implicit +Inf bucket), a ``_sum``, a ``_count``, and the most
+    recent :class:`Exemplar` per bucket.  :meth:`family` renders the whole
+    thing as ONE :class:`MetricFamily` of type ``histogram`` whose samples
+    carry ``_bucket``/``_sum``/``_count`` suffixes — so it flows through
+    ``encode_text``/``flatten`` and the structured scrape fast path like
+    any other family, and the TSDB ingests each suffixed series by its
+    full wire name."""
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        bounds: tuple[float, ...] = DEFAULT_DURATION_BUCKETS,
+    ):
+        if not bounds:
+            raise ValueError(f"histogram {name}: needs at least one bound")
+        self.name = name
+        self.help = help
+        self.bounds = tuple(sorted(bounds))
+        if self.bounds[-1] == float("inf"):
+            self.bounds = self.bounds[:-1]  # +Inf is implicit
+        # labels -> [per-bucket incremental counts (+Inf last), sum, count,
+        #            per-bucket latest exemplar]
+        self._series: dict[tuple[tuple[str, str], ...], list] = {}
+
+    def observe(
+        self, value: float, exemplar: Exemplar | None = None, **labels: str
+    ) -> None:
+        key = tuple(sorted(labels.items()))
+        state = self._series.get(key)
+        if state is None:
+            n = len(self.bounds) + 1
+            state = [[0] * n, 0.0, 0, [None] * n]
+            self._series[key] = state
+        idx = bisect.bisect_left(self.bounds, value)  # first bound >= value
+        state[0][idx] += 1
+        state[1] += value
+        state[2] += 1
+        if exemplar is not None:
+            state[3][idx] = exemplar
+
+    def cumulative_buckets(
+        self, labels: tuple[tuple[str, str], ...] = ()
+    ) -> list[tuple[float, float]]:
+        """``[(le, cumulative_count), ...]`` including +Inf for one label
+        set — the exact shape ``rules.bucket_quantile`` consumes, for
+        in-process quantile reads that skip the scrape round trip."""
+        state = self._series.get(labels)
+        if state is None:
+            return []
+        out: list[tuple[float, float]] = []
+        cumulative = 0
+        for i, bound in enumerate(self.bounds + (float("inf"),)):
+            cumulative += state[0][i]
+            out.append((bound, float(cumulative)))
+        return out
+
+    def family(self) -> MetricFamily:
+        fam = MetricFamily(self.name, type="histogram", help=self.help)
+        bounds = self.bounds + (float("inf"),)
+        for key in sorted(self._series):
+            counts, total, count, exemplars = self._series[key]
+            cumulative = 0
+            for i, bound in enumerate(bounds):
+                cumulative += counts[i]
+                fam.samples.append(
+                    Sample(
+                        float(cumulative),
+                        tuple(sorted(key + (("le", format_le(bound)),))),
+                        suffix="_bucket",
+                        exemplar=exemplars[i],
+                    )
+                )
+            fam.samples.append(Sample(total, key, suffix="_sum"))
+            fam.samples.append(Sample(float(count), key, suffix="_count"))
+        return fam
 
 
 @dataclass(frozen=True)

@@ -46,7 +46,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
-from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily, Sample
+from k8s_gpu_hpa_tpu.metrics.schema import Exemplar, MetricFamily, Sample
 from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 
 LabelSet = tuple[tuple[str, str], ...]
@@ -142,6 +142,10 @@ class TimeSeriesDB:
         #: (name, labels) -> marker ts for series ended by a staleness
         #: marker; the GC sweep drops them once the marker ages out
         self._stale_pending: dict[tuple[str, LabelSet], float] = {}
+        #: (name, labels) -> latest Exemplar attached to that series (the
+        #: metrics→traces bridge: a histogram bucket's newest traced
+        #: observation).  Persisted through WAL records and snapshots.
+        self._exemplars: dict[tuple[str, LabelSet], Exemplar] = {}
         self._total_points = 0
         self._appends_since_gc = 0
         #: active read-capture sink (see begin_capture), else None
@@ -165,6 +169,7 @@ class TimeSeriesDB:
         value: float,
         ts: float | None = None,
         origin: int | None = None,
+        exemplar: Exemplar | None = None,
     ) -> None:
         ts = self.clock.now() if ts is None else ts
         by_name = self._data.get(name)
@@ -207,11 +212,13 @@ class TimeSeriesDB:
         elif self._stale_pending:
             # a live point resurrects a marker-ended series: cancel its GC
             self._stale_pending.pop((name, series.labels), None)
+        if exemplar is not None:
+            self._exemplars[(name, series.labels)] = exemplar
         self._appends_since_gc += 1
         if self._appends_since_gc >= self.GC_EVERY:
             self.gc()
         if self.wal is not None and not self._replaying:
-            self.wal.log_append(name, series.labels, value, ts, origin)
+            self.wal.log_append(name, series.labels, value, ts, origin, exemplar)
             self._wal_records_since_snapshot += 1
             if self._wal_records_since_snapshot >= self.snapshot_every:
                 self.snapshot()
@@ -231,6 +238,7 @@ class TimeSeriesDB:
             if now - marker_ts <= self.lookback:
                 continue
             del self._stale_pending[key]
+            self._exemplars.pop(key, None)
             name, labels = key
             by_name = self._data.get(name)
             series = by_name.pop(labels, None) if by_name is not None else None
@@ -287,6 +295,10 @@ class TimeSeriesDB:
             "stale_pending": [
                 [name, list(labels), ts]
                 for (name, labels), ts in self._stale_pending.items()
+            ],
+            "exemplars": [
+                [name, list(labels), ex.value, ex.trace_id, ex.span_id, ex.ts]
+                for (name, labels), ex in self._exemplars.items()
             ],
         }
         self.wal.write_snapshot(payload)
@@ -346,6 +358,14 @@ class TimeSeriesDB:
                 labels = tuple((k, v) for k, v in labels)
                 labels = db._intern.setdefault(labels, labels)
                 db._stale_pending[(name, labels)] = ts
+            for name, labels, value, trace_id, span_id, ex_ts in payload.get(
+                "exemplars", []
+            ):
+                labels = tuple((k, v) for k, v in labels)
+                labels = db._intern.setdefault(labels, labels)
+                db._exemplars[(name, labels)] = Exemplar(
+                    value, trace_id, span_id, ex_ts
+                )
         replayed = 0
         dropped = 0
         db._replaying = True
@@ -353,8 +373,26 @@ class TimeSeriesDB:
             for rec in tail:
                 labels = tuple((k, v) for k, v in rec["labels"])
                 value = float("nan") if rec["op"] == "stale" else rec["value"]
+                ex_rec = rec.get("exemplar")
+                exemplar = (
+                    None
+                    if ex_rec is None
+                    else Exemplar(
+                        ex_rec["value"],
+                        ex_rec["trace_id"],
+                        ex_rec["span_id"],
+                        ex_rec.get("ts"),
+                    )
+                )
                 try:
-                    db.append(rec["name"], labels, value, rec["ts"], rec.get("origin"))
+                    db.append(
+                        rec["name"],
+                        labels,
+                        value,
+                        rec["ts"],
+                        rec.get("origin"),
+                        exemplar=exemplar,
+                    )
                 except ValueError:
                     dropped += 1
                     continue
@@ -472,6 +510,19 @@ class TimeSeriesDB:
         """Write a staleness marker ending the series now (Prometheus writes
         these when a target fails to scrape or a rule stops producing)."""
         self.append(name, labels, float("nan"), ts, origin=origin)
+
+    def exemplar(self, name: str, labels: LabelSet) -> Exemplar | None:
+        """Latest exemplar attached to the series, else None."""
+        return self._exemplars.get((name, labels))
+
+    def exemplars_of(self, name: str) -> dict[LabelSet, Exemplar]:
+        """All exemplars for series of ``name`` (bucket series of a
+        histogram), keyed by label set — the lint/doctor traversal."""
+        return {
+            labels: ex
+            for (n, labels), ex in self._exemplars.items()
+            if n == name
+        }
 
     def version(self, name: str) -> int:
         """Monotonic write counter for ``name``: bumps on every append to any
@@ -705,7 +756,7 @@ class Scraper:
                 self._backoff(target, ts)
                 self._record_up(target, 0.0, ts)
                 if selfmetrics is not None:
-                    self._observe_scrape(target, wall_start, duration)
+                    self._observe_scrape(target, wall_start, duration, origin)
                 if span is not None:
                     tracer.close(span, ok=False, error=str(exc))
                 continue
@@ -734,8 +785,20 @@ class Scraper:
                         # parse_text and Sample.make both emit sorted label
                         # tuples, so the sample's labels ARE the series key
                         key = sample.labels
-                    db_append(fam_name, key, sample.value, ts, origin=origin)
-                    produced.add((fam_name, key))
+                    # histogram samples carry a suffix: the TSDB series is
+                    # the full wire name (x_bucket/x_sum/x_count)
+                    series_name = (
+                        fam_name + sample.suffix if sample.suffix else fam_name
+                    )
+                    db_append(
+                        series_name,
+                        key,
+                        sample.value,
+                        ts,
+                        origin=origin,
+                        exemplar=sample.exemplar,
+                    )
+                    produced.add((series_name, key))
                     count += 1
             # series that vanished from the exposition also go stale
             for name, labels in target.last_series - produced:
@@ -747,7 +810,7 @@ class Scraper:
                 up_labels = self._up_labels(target)
             db_append("up", up_labels, 1.0, ts)
             if selfmetrics is not None:
-                self._observe_scrape(target, wall_start, duration)
+                self._observe_scrape(target, wall_start, duration, origin)
             if span is not None:
                 links: tuple[int, ...] = ()
                 if target.trace_origin is not None:
@@ -758,13 +821,18 @@ class Scraper:
         return count
 
     def _observe_scrape(
-        self, target: ScrapeTarget, wall_start: float, duration: float | None
+        self,
+        target: ScrapeTarget,
+        wall_start: float,
+        duration: float | None,
+        span_id: int | None = None,
     ) -> None:
         """Report the scrape's duration: the modeled one when the target
         returned a TimedExposition (virtual-time harnesses), wall-clock
-        otherwise (production semantics)."""
+        otherwise (production semantics).  ``span_id`` (this attempt's
+        scrape span) becomes the histogram bucket's exemplar."""
         if self.selfmetrics is None:
             return
         if duration is None:
             duration = time.perf_counter() - wall_start
-        self.selfmetrics.observe_scrape(target.name or "?", duration)
+        self.selfmetrics.observe_scrape(target.name or "?", duration, span_id)

@@ -74,9 +74,12 @@ class WriteAheadLog:
         value: float,
         ts: float,
         origin: int | None = None,
+        exemplar=None,
     ) -> None:
         """Record one accepted append.  NaN (a staleness marker) is written
-        as ``op: "stale"`` with no value field."""
+        as ``op: "stale"`` with no value field.  An attached exemplar
+        (``metrics.schema.Exemplar``, histogram bucket observations) rides
+        along so the metrics→traces bridge survives a restart."""
         if value != value:  # NaN
             rec: dict = {"op": "stale", "name": name, "labels": list(labels), "ts": ts}
         else:
@@ -89,6 +92,13 @@ class WriteAheadLog:
             }
         if origin is not None:
             rec["origin"] = origin
+        if exemplar is not None and rec["op"] == "append":
+            rec["exemplar"] = {
+                "value": exemplar.value,
+                "trace_id": exemplar.trace_id,
+                "span_id": exemplar.span_id,
+                "ts": exemplar.ts,
+            }
         self._write_line(json.dumps(rec, separators=(",", ":")))
 
     def _write_line(self, line: str) -> None:

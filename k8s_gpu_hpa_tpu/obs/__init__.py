@@ -4,15 +4,18 @@ The observability layer production autoscalers ship and the reference
 stack lacks entirely (SURVEY.md §5): structured spans for every pipeline
 stage (trace.py, validated against schema.py), lineage walks from scale
 events back to raw chip sweeps (lineage.py), signal-propagation latency
-measurement (latency.py), and the pipeline's own Prometheus self-metrics
-(selfmetrics.py).  Wired in by control/loop.py when a Tracer is passed to
-AutoscalingPipeline; surfaced by ``python -m k8s_gpu_hpa_tpu.simulate
-trace``, bench.py's ``signal_latency`` rung, and the chaos storm's
+measurement (latency.py), the pipeline's own Prometheus self-metrics —
+gauges plus latency histograms with trace exemplars (selfmetrics.py) —
+and declared SLOs with multi-window burn-rate alerting (slo.py).  Wired
+in by control/loop.py when a Tracer is passed to AutoscalingPipeline;
+surfaced by ``python -m k8s_gpu_hpa_tpu.simulate trace``/``slo``,
+bench.py's ``signal_latency``/``slo_burn`` rungs, and the chaos storm's
 span-annotated RecoveryReports.
 """
 
 from k8s_gpu_hpa_tpu.obs.latency import (
     TracedLoad,
+    histogram_quantiles,
     percentile,
     propagation_report,
 )
@@ -23,38 +26,75 @@ from k8s_gpu_hpa_tpu.obs.schema import (
     validate_span_fields,
 )
 from k8s_gpu_hpa_tpu.obs.selfmetrics import (
+    ADAPTER_QUERY_LATENCY,
     DECISION_REASONS,
     HPA_DECISION_TOTAL,
     HPA_SYNC_DURATION,
+    HPA_SYNC_LATENCY,
+    RULE_EVAL_LATENCY,
     RULE_EVAL_STALENESS,
     SCRAPE_DURATION,
+    SCRAPE_LATENCY,
+    SELF_HISTOGRAM_NAMES,
+    SELF_HISTOGRAM_SERIES,
     SELF_METRIC_NAMES,
     SELF_TARGET_NAME,
+    SIGNAL_PROPAGATION,
+    SIGNAL_PROPAGATION_BUCKETS,
     PipelineSelfMetrics,
     decision_reason_label,
+)
+from k8s_gpu_hpa_tpu.obs.slo import (
+    PROPAGATION_BUDGET_SECONDS,
+    SLO_EVENTS_TOTAL,
+    SLO_GOOD_TOTAL,
+    SLODefinition,
+    SLORecorder,
+    burn_rate_alerts,
+    shipped_slo_alerts,
+    shipped_slo_recorders,
+    shipped_slos,
 )
 from k8s_gpu_hpa_tpu.obs.trace import Span, Tracer, read_jsonl
 
 __all__ = [
+    "ADAPTER_QUERY_LATENCY",
     "DECISION_REASONS",
     "HPA_DECISION_TOTAL",
     "HPA_SYNC_DURATION",
+    "HPA_SYNC_LATENCY",
     "LINEAGE_ORDER",
+    "PROPAGATION_BUDGET_SECONDS",
     "PipelineSelfMetrics",
+    "RULE_EVAL_LATENCY",
     "RULE_EVAL_STALENESS",
     "SCRAPE_DURATION",
+    "SCRAPE_LATENCY",
+    "SELF_HISTOGRAM_NAMES",
+    "SELF_HISTOGRAM_SERIES",
     "SELF_METRIC_NAMES",
     "SELF_TARGET_NAME",
+    "SIGNAL_PROPAGATION",
+    "SIGNAL_PROPAGATION_BUCKETS",
+    "SLO_EVENTS_TOTAL",
+    "SLO_GOOD_TOTAL",
+    "SLODefinition",
+    "SLORecorder",
     "SPAN_SCHEMA",
     "Span",
     "TracedLoad",
     "Tracer",
+    "burn_rate_alerts",
     "decision_reason_label",
     "format_lineage",
+    "histogram_quantiles",
     "index_spans",
     "lineage_of",
     "percentile",
     "propagation_report",
     "read_jsonl",
+    "shipped_slo_alerts",
+    "shipped_slo_recorders",
+    "shipped_slos",
     "validate_span_fields",
 ]

@@ -22,10 +22,22 @@ from k8s_gpu_hpa_tpu.obs.trace import Span, Tracer
 
 
 def percentile(values: list[float], q: float) -> float | None:
-    """Nearest-rank percentile (q in [0,100]); None on empty input."""
+    """Nearest-rank percentile (q in [0,100]); None on empty input.
+
+    Boundary behavior is pinned explicitly — this function is the exact
+    reference ``HistogramQuantile`` is property-tested against, so the
+    extremes must not depend on rounding accidents: q<=0 returns the
+    minimum, q>=100 the maximum, and a single-sample input returns that
+    sample at every q (round(0.5) banker's-rounds to 0 in Python, which
+    the old max(1, ...) clamp only covered incidentally).
+    """
     if not values:
         return None
     ordered = sorted(values)
+    if q <= 0 or len(ordered) == 1:
+        return ordered[0]
+    if q >= 100:
+        return ordered[-1]
     rank = max(1, round(q / 100.0 * len(ordered)))
     return ordered[min(rank, len(ordered)) - 1]
 
@@ -66,7 +78,29 @@ class TracedLoad:
         return value
 
 
-def propagation_report(spans: list[Span]) -> dict:
+def histogram_quantiles(
+    hist,
+    qs: tuple[float, ...] = (0.50, 0.95, 0.99),
+    labels: tuple[tuple[str, str], ...] = (),
+) -> dict[str, float | None]:
+    """Quantile estimates straight off an in-process histogram's cumulative
+    buckets (``metrics.schema.Histogram``), via the same classic bucket
+    interpolation the query-side ``HistogramQuantile`` node uses.
+
+    The live counterpart of :func:`percentile`: ``percentile`` is exact but
+    needs every raw observation retained; the histogram answer is bounded
+    in error by the width of the bucket the rank lands in, at O(buckets)
+    memory.  Keys are ``p50``-style; values None while the histogram (or
+    the addressed label set) is empty."""
+    from k8s_gpu_hpa_tpu.metrics.rules import bucket_quantile
+
+    buckets = hist.cumulative_buckets(labels)
+    return {
+        f"p{round(q * 100):g}": bucket_quantile(buckets, q) for q in qs
+    }
+
+
+def propagation_report(spans: list[Span], selfmetrics=None) -> dict:
     """Pair each workload change with the first following HPA sync and the
     first following scale event (both cut off at the next change — a scale
     caused by a later step must not be credited to an earlier one).
@@ -75,7 +109,19 @@ def propagation_report(spans: list[Span]) -> dict:
     distributions: ``sync`` (change → first sync, the pipeline's *noticing*
     delay, bounded by scrape+rule+sync intervals) and ``scale`` (change →
     scale event, the full acting delay; None-filtered when a change caused
-    no scale, e.g. a step inside the tolerance band)."""
+    no scale, e.g. a step inside the tolerance band).
+
+    With ``selfmetrics`` (a PipelineSelfMetrics), the report also carries
+    ``hist_scale_latency_p50/p95/p99`` — the same distribution read off the
+    live ``signal_propagation_seconds`` histogram, which is what dashboards
+    and the SLO see; the exact pairs above are the reference the
+    histogram's bucket-width error is tested against."""
+    hist_quantiles: dict[str, float | None] = {}
+    if selfmetrics is not None:
+        hist_quantiles = {
+            f"hist_scale_latency_{k}": v
+            for k, v in histogram_quantiles(selfmetrics.hist_propagation).items()
+        }
     changes = sorted(
         (s for s in spans if s.kind == "workload_change"),
         key=lambda s: (s.start, s.span_id),
@@ -121,4 +167,5 @@ def propagation_report(spans: list[Span]) -> dict:
         "scale_latency_p95": percentile(scale_lat, 95),
         "changes_total": len(records),
         "changes_scaled": len(scale_lat),
+        **hist_quantiles,
     }

@@ -60,7 +60,7 @@ SPAN_SCHEMA: dict[str, dict] = {
         "description": "one custom/external-metrics API read; links to the "
         "rule evaluations that produced the points served",
         "required": frozenset({"api", "metric", "found"}),
-        "optional": frozenset({"value"}),
+        "optional": frozenset({"value", "duration_seconds"}),
         "link_kinds": frozenset({"rule_eval", "scrape"}),
     },
     "hpa_sync": {

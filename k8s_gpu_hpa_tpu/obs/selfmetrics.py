@@ -21,7 +21,12 @@ import math
 from collections import Counter
 
 from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
-from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+from k8s_gpu_hpa_tpu.metrics.schema import (
+    DEFAULT_DURATION_BUCKETS,
+    Exemplar,
+    Histogram,
+    MetricFamily,
+)
 
 #: wall-clock duration of the last HPA sync pass (gauge)
 HPA_SYNC_DURATION = "hpa_sync_duration_seconds"
@@ -40,6 +45,48 @@ SELF_METRIC_NAMES = (
     RULE_EVAL_STALENESS,
     HPA_DECISION_TOTAL,
 )
+
+# ---- distribution self-metrics (histograms with trace exemplars) -----------
+#
+# The gauges above keep their last-value semantics (dashboards/doctor built
+# on them stay valid); the histograms below add the DISTRIBUTION — the tail
+# that predicts a missed scale-up — and each bucket observation carries an
+# exemplar pointing at the span that produced it.
+
+#: HPA sync pass duration distribution
+HPA_SYNC_LATENCY = "hpa_sync_latency_seconds"
+#: scrape duration distribution (all targets pooled; the per-target gauge
+#: above keeps the breakdown — a fleet of 1000 targets must not mint 1000
+#: bucket series)
+SCRAPE_LATENCY = "scrape_latency_seconds"
+#: full recording-rule evaluation duration distribution
+RULE_EVAL_LATENCY = "rule_eval_latency_seconds"
+#: custom-metrics adapter query duration distribution
+ADAPTER_QUERY_LATENCY = "adapter_query_latency_seconds"
+#: end-to-end signal propagation: workload change -> scale event (seconds
+#: of *virtual* time — the north-star latency, ROADMAP budget 60s)
+SIGNAL_PROPAGATION = "signal_propagation_seconds"
+
+SELF_HISTOGRAM_NAMES = (
+    HPA_SYNC_LATENCY,
+    SCRAPE_LATENCY,
+    RULE_EVAL_LATENCY,
+    ADAPTER_QUERY_LATENCY,
+    SIGNAL_PROPAGATION,
+)
+
+#: every TSDB series the self-histograms expand to (the manifest contract
+#: test and the Grafana generator address buckets/sums/counts directly)
+SELF_HISTOGRAM_SERIES = tuple(
+    name + suffix
+    for name in SELF_HISTOGRAM_NAMES
+    for suffix in ("_bucket", "_sum", "_count")
+)
+
+#: propagation buckets in virtual seconds; 30 is a bound on purpose — the
+#: signal-propagation SLO (obs/slo.py) counts its good events straight off
+#: the le="30" bucket series, so the budget must be a bucket boundary
+SIGNAL_PROPAGATION_BUCKETS = (5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0, 90.0, 120.0)
 
 #: the scrape-target name the pipeline serves its own metrics under
 SELF_TARGET_NAME = "pipeline-self"
@@ -69,25 +116,91 @@ def decision_reason_label(last_reason: str) -> str:
 
 
 class PipelineSelfMetrics:
-    """Accumulates stage reports; renders them as exposition text."""
+    """Accumulates stage reports; renders them as exposition text.
 
-    def __init__(self):
+    ``clock`` (optional) timestamps exemplars; every ``span_id`` a hook
+    receives becomes the exemplar on the bucket the observation lands in,
+    so a tail bucket always links to a concrete span in the trace export
+    (trace_id == span_id: the tracer is single-process, see
+    ``metrics.schema.Exemplar``)."""
+
+    def __init__(self, clock=None):
+        self.clock = clock
         self.sync_durations: list[float] = []  # every sync, for percentiles
         self._scrape_duration: dict[str, float] = {}
         self._rule_staleness: dict[str, float] = {}
         self.decisions: Counter = Counter()
+        self.hist_sync = Histogram(
+            HPA_SYNC_LATENCY, "HPA sync pass duration distribution"
+        )
+        self.hist_scrape = Histogram(
+            SCRAPE_LATENCY, "scrape duration distribution (all targets)"
+        )
+        self.hist_rule_eval = Histogram(
+            RULE_EVAL_LATENCY, "full recording-rule evaluation duration"
+        )
+        self.hist_adapter = Histogram(
+            ADAPTER_QUERY_LATENCY, "custom-metrics adapter query duration"
+        )
+        self.hist_propagation = Histogram(
+            SIGNAL_PROPAGATION,
+            "workload change to scale event, virtual seconds",
+            bounds=SIGNAL_PROPAGATION_BUCKETS,
+        )
+
+    def histograms(self) -> tuple[Histogram, ...]:
+        return (
+            self.hist_sync,
+            self.hist_scrape,
+            self.hist_rule_eval,
+            self.hist_adapter,
+            self.hist_propagation,
+        )
+
+    def _exemplar(self, value: float, span_id: int | None) -> Exemplar | None:
+        if span_id is None:
+            return None
+        ts = None if self.clock is None else self.clock.now()
+        return Exemplar(value, trace_id=span_id, span_id=span_id, ts=ts)
 
     # ---- stage report hooks ------------------------------------------------
 
-    def observe_sync(self, duration: float, last_reason: str) -> None:
+    def observe_sync(
+        self, duration: float, last_reason: str, span_id: int | None = None
+    ) -> None:
         self.sync_durations.append(duration)
         self.decisions[decision_reason_label(last_reason)] += 1
+        self.hist_sync.observe(duration, self._exemplar(duration, span_id))
 
-    def observe_scrape(self, target: str, duration: float) -> None:
+    def observe_scrape(
+        self, target: str, duration: float, span_id: int | None = None
+    ) -> None:
         self._scrape_duration[target] = duration
+        self.hist_scrape.observe(duration, self._exemplar(duration, span_id))
 
-    def observe_rule_eval(self, rule: str, staleness: float) -> None:
+    def observe_rule_eval(
+        self,
+        rule: str,
+        staleness: float,
+        duration: float | None = None,
+        span_id: int | None = None,
+    ) -> None:
+        """``staleness`` reports on every (full or skipped) eval;
+        ``duration`` only on full evals — a skip costs integer compares,
+        observing it would drown the histogram in near-zeros."""
         self._rule_staleness[rule] = staleness
+        if duration is not None:
+            self.hist_rule_eval.observe(duration, self._exemplar(duration, span_id))
+
+    def observe_adapter_query(
+        self, duration: float, span_id: int | None = None
+    ) -> None:
+        self.hist_adapter.observe(duration, self._exemplar(duration, span_id))
+
+    def observe_propagation(
+        self, latency: float, span_id: int | None = None
+    ) -> None:
+        self.hist_propagation.observe(latency, self._exemplar(latency, span_id))
 
     # ---- exposition --------------------------------------------------------
 
@@ -116,4 +229,6 @@ class PipelineSelfMetrics:
         )
         for reason, count in sorted(self.decisions.items()):
             decisions.add(float(count), reason=reason)
-        return encode_text([sync, scrape, staleness, decisions])
+        families = [sync, scrape, staleness, decisions]
+        families.extend(h.family() for h in self.histograms())
+        return encode_text(families)

@@ -1,0 +1,253 @@
+"""SLO declarations, error-budget accounting, and burn-rate alerting.
+
+The reference stack has no notion of a service-level objective: the one
+alertable fact it could state is "a pod is hot".  This module closes the
+loop the Google SRE Workbook way — declare the objective once, derive
+everything else from it:
+
+- :class:`SLODefinition` — the declaration: a name, an objective (the
+  fraction of events that must be good), and where good/total events come
+  from (a pair of cumulative counters, or a 0/1 gauge vector like ``up``).
+- :class:`SLORecorder` — error-budget accounting in the TSDB.  A
+  duck-typed RecordingRule (``evaluate_into``) that folds each SLO's
+  source into two NORMALIZED cumulative counters,
+  ``slo_good_total{slo=...}`` / ``slo_events_total{slo=...}`` — one shape
+  for every SLO, so the burn-rate exprs, the Grafana row, and the
+  PrometheusRule export never care where events originally came from.
+- :func:`burn_rate_alerts` — the multi-window multi-burn-rate pair per
+  SLO (Workbook ch. 5): *fast* pages on burn > 14.4 over 5m AND 1h
+  (2% of a 30-day budget in an hour), *slow* tickets on burn > 6.0 over
+  30m AND 6h.  The two-window AND is the flap guard: a window long enough
+  to mean it, a window short enough to reset quickly once the burn stops.
+
+Burn 1.0 means the budget is being spent exactly at the rate the
+objective allows; the thresholds are multiples of that spend rate
+(``metrics.rules.BurnRate``).  Both alerts are gated on traffic: no
+events in the window means no evidence, never a page.
+
+Shipped SLOs (:func:`shipped_slos`):
+
+- ``signal-propagation``: 95% of workload-change→scale-event
+  propagations complete within 30 virtual seconds — good events counted
+  straight off the ``signal_propagation_seconds_bucket{le="30"}`` series
+  (which is why 30 must be a bucket boundary, obs/selfmetrics.py).
+- ``scrape-success``: 99% of scrape attempts succeed — counted off the
+  per-target ``up`` gauge the scraper writes every sweep (1 healthy,
+  0 failed), so a scrape blackout starts burning budget on the very next
+  tick.
+
+Scored against chaos by ``simulate slo`` and the bench's ``slo_burn``
+rung: a clean window must fire nothing (false-positive check), an
+injected scrape blackout must fire (false-negative check).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_gpu_hpa_tpu.metrics.rules import AlertRule, AndOn, BurnRate, Cmp, _fmt_window
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs.selfmetrics import SIGNAL_PROPAGATION
+
+#: normalized error-budget counters every SLO records into (label: slo=<name>)
+SLO_GOOD_TOTAL = "slo_good_total"
+SLO_EVENTS_TOTAL = "slo_events_total"
+
+#: SRE Workbook thresholds and window pairs (short, long) in seconds
+FAST_BURN = 14.4
+FAST_WINDOWS = (300.0, 3600.0)  # 5m / 1h -> page
+SLOW_BURN = 6.0
+SLOW_WINDOWS = (1800.0, 21600.0)  # 30m / 6h -> ticket
+
+
+@dataclass(frozen=True)
+class SLODefinition:
+    """One declared objective and the series its events are counted from.
+
+    ``source`` picks the counting mode:
+
+    - ``"counter"``: ``good_series``/``total_series`` are already
+      cumulative counters (histogram ``_bucket``/``_count`` series); the
+      recorder mirrors their current sums.
+    - ``"gauge"``: ``good_series`` is a 0/1 gauge vector (``up``); each
+      recorder tick adds the vector's value-sum to good and its sample
+      count to total (``total_series`` unused).
+    """
+
+    name: str
+    objective: float  # e.g. 0.99 — fraction of events that must be good
+    description: str
+    source: str  # "counter" | "gauge"
+    good_series: str
+    total_series: str = ""
+    good_matchers: dict[str, str] = field(default_factory=dict)
+    total_matchers: dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.source not in ("counter", "gauge"):
+            raise ValueError(f"unknown SLO source mode {self.source!r}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1) exclusive")
+        if self.source == "counter" and not self.total_series:
+            raise ValueError("counter-mode SLO requires total_series")
+
+    @property
+    def labels(self) -> tuple[tuple[str, str], ...]:
+        return (("slo", self.name),)
+
+
+class SLORecorder:
+    """Error-budget accounting: one SLO's events folded into the two
+    normalized counters each rule-eval tick.
+
+    Duck-types ``RecordingRule.evaluate_into`` so the existing
+    ``RuleEvaluator`` drives it in group order (recorders before alerts —
+    the burn exprs read what this tick just wrote).  Counter state seeds
+    itself from the TSDB on the first tick, so a component restart over a
+    recovered WAL continues the counters instead of resetting them (a
+    reset would be clamped by BurnRate, but would also erase any burn in
+    flight)."""
+
+    def __init__(self, slo: SLODefinition):
+        self.slo = slo
+        #: RecordingRule protocol: the output name, for harness listings
+        self.record = f"{SLO_GOOD_TOTAL}{{slo={slo.name}}}"
+        self._good = 0.0
+        self._total = 0.0
+        self._seeded = False
+
+    def _sum(
+        self, db: TimeSeriesDB, name: str, matchers: dict[str, str], at: float
+    ) -> tuple[float, int] | None:
+        vec = db.instant_vector(name, matchers, at)
+        if not vec:
+            return None
+        return sum(s.value for s in vec), len(vec)
+
+    def evaluate_into(
+        self,
+        db: TimeSeriesDB,
+        at: float | None = None,
+        tracer=None,
+        selfmetrics=None,
+    ) -> int:
+        ts = db.clock.now() if at is None else at
+        if not self._seeded:
+            self._good = db.latest(SLO_GOOD_TOTAL, dict(self.slo.labels)) or 0.0
+            self._total = db.latest(SLO_EVENTS_TOTAL, dict(self.slo.labels)) or 0.0
+            self._seeded = True
+        if self.slo.source == "gauge":
+            read = self._sum(db, self.slo.good_series, self.slo.good_matchers, ts)
+            if read is None:
+                return 0  # source absent: no evidence this tick, no write
+            value_sum, count = read
+            self._good += value_sum
+            self._total += count
+        else:
+            good = self._sum(db, self.slo.good_series, self.slo.good_matchers, ts)
+            total = self._sum(db, self.slo.total_series, self.slo.total_matchers, ts)
+            if total is None:
+                return 0  # histogram not scraped yet / expired: skip
+            # mirror the source counters, never regress (a source briefly
+            # dropping out of the lookback window must not read as a reset)
+            self._good = max(self._good, (good or (0.0, 0))[0])
+            self._total = max(self._total, total[0])
+        db.append(SLO_GOOD_TOTAL, self.slo.labels, self._good, ts)
+        db.append(SLO_EVENTS_TOTAL, self.slo.labels, self._total, ts)
+        return 2
+
+
+def _camel(name: str) -> str:
+    return "".join(part.capitalize() for part in name.replace("_", "-").split("-"))
+
+
+def _burn_alert(
+    slo: SLODefinition,
+    windows: tuple[float, float],
+    threshold: float,
+    severity: str,
+    speed: str,
+) -> AlertRule:
+    """One multi-window burn alert: fire only while BOTH windows burn
+    above the threshold (short window = fast reset, long window = flap
+    guard)."""
+    short, long = windows
+
+    def burn(window: float) -> BurnRate:
+        return BurnRate(
+            good_name=SLO_GOOD_TOTAL,
+            total_name=SLO_EVENTS_TOTAL,
+            objective=slo.objective,
+            window=window,
+            good_matchers=dict(slo.labels),
+            total_matchers=dict(slo.labels),
+        )
+
+    return AlertRule(
+        alert=f"SLO{_camel(slo.name)}{speed.capitalize()}Burn",
+        expr=AndOn(
+            Cmp(burn(short), ">", threshold),
+            Cmp(burn(long), ">", threshold),
+        ),
+        labels={
+            "severity": severity,
+            "slo": slo.name,
+            "burn": speed,
+            "window": f"{_fmt_window(short)}/{_fmt_window(long)}",
+        },
+        annotations={
+            "summary": f"SLO {slo.name} ({slo.description}) is burning "
+            f"error budget over {threshold:g}x the sustainable rate on "
+            f"both the {_fmt_window(short)} and {_fmt_window(long)} "
+            f"windows — at this burn the {slo.objective:.0%} objective "
+            "fails well inside the budget period"
+        },
+    )
+
+
+def burn_rate_alerts(slo: SLODefinition) -> list[AlertRule]:
+    """The Workbook pair for one SLO: fast (page) + slow (ticket)."""
+    return [
+        _burn_alert(slo, FAST_WINDOWS, FAST_BURN, "critical", "fast"),
+        _burn_alert(slo, SLOW_WINDOWS, SLOW_BURN, "warning", "slow"),
+    ]
+
+
+#: virtual-seconds propagation budget a good event must beat; MUST be a
+#: bucket boundary of SIGNAL_PROPAGATION_BUCKETS (good events are counted
+#: off that bucket's series)
+PROPAGATION_BUDGET_SECONDS = 30.0
+
+
+def shipped_slos() -> list[SLODefinition]:
+    """THE declared SLO list — single source for the pipeline wiring
+    (control/loop.py), the PrometheusRule export
+    (tools/gen_prometheusrule.py), the Grafana SLO row, and the
+    ``slo_burn`` bench rung."""
+    return [
+        SLODefinition(
+            name="signal-propagation",
+            objective=0.95,
+            description="95% of workload-change->scale-event propagations "
+            f"complete within {PROPAGATION_BUDGET_SECONDS:g}s",
+            source="counter",
+            good_series=SIGNAL_PROPAGATION + "_bucket",
+            total_series=SIGNAL_PROPAGATION + "_count",
+            good_matchers={"le": f"{PROPAGATION_BUDGET_SECONDS:g}"},
+        ),
+        SLODefinition(
+            name="scrape-success",
+            objective=0.99,
+            description="99% of scrape attempts succeed",
+            source="gauge",
+            good_series="up",
+        ),
+    ]
+
+
+def shipped_slo_recorders() -> list[SLORecorder]:
+    return [SLORecorder(slo) for slo in shipped_slos()]
+
+
+def shipped_slo_alerts() -> list[AlertRule]:
+    return [alert for slo in shipped_slos() for alert in burn_rate_alerts(slo)]

@@ -240,6 +240,144 @@ def run_scenario(
     return report
 
 
+#: the SLO check's rising staircase: (scenario-seconds, offered %-of-chip).
+#: Rising only — scale-downs sit behind the 300 s stabilization window, and
+#: a clean-phase propagation latency measured across that window would read
+#: as budget burn when nothing is broken.  Each step is sized to land the
+#: shared signal above the 40-target tolerance band at the current replica
+#: count, so every step produces a scale event (a propagation observation).
+SLO_STAIRCASE: tuple[tuple[float, float], ...] = (
+    (60.0, 60.0),
+    (180.0, 120.0),
+    (300.0, 240.0),
+)
+
+
+def _slo_load(t: float) -> float:
+    level = 20.0
+    for at, value in SLO_STAIRCASE:
+        if t >= at:
+            level = value
+    return level
+
+
+def _slo_pipeline(pod_start_latency: float):
+    """A fixed traced pipeline under the SLO staircase — manifest-independent
+    (like the chaos storm) so burn numbers compare run-to-run."""
+    from k8s_gpu_hpa_tpu.obs import TracedLoad, Tracer
+
+    clock = VirtualClock()
+    tracer = Tracer(clock)
+    cluster = SimCluster(
+        clock,
+        nodes=[("tpu-node-0", 4), ("tpu-node-1", 4)],
+        pod_start_latency=pod_start_latency,
+    )
+    dep = SimDeployment(
+        cluster, "tpu-test", "tpu-test", load_fn=_slo_load, load_mode="shared"
+    )
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    base = clock.now()
+    dep.load_fn = TracedLoad(lambda t: _slo_load(t - base), tracer)
+    pipe = AutoscalingPipeline(cluster, dep, tracer=tracer)
+    pipe.start()
+    return pipe
+
+
+def run_slo_check(
+    duration: float = 420.0,
+    fault_at: float = 120.0,
+    fault_duration: float = 150.0,
+    pod_start_latency: float = 12.0,
+) -> dict:
+    """Score the SLO burn-rate alerts against chaos, both ways.
+
+    Two identical runs of the staircase scenario on a traced pipeline
+    (which wires the SLO recorders + Workbook alert pairs, control/loop.py):
+
+    - **clean**: no faults.  Any SLO alert firing at any 1 Hz sample is a
+      false positive — burn-rate alerting exists precisely to not page on a
+      healthy pipeline.
+    - **fault**: a total scrape blackout at ``fault_at`` for
+      ``fault_duration``.  The scrape-success SLO must catch it: the fast
+      (page) burn alert not firing is a false negative.
+
+    Returns per-alert first-fire times plus detection latencies (seconds
+    from injection to first firing sample) for the fast and slow
+    scrape-success alerts; ``ok`` is the combined verdict.
+    """
+    from k8s_gpu_hpa_tpu.chaos import ChaosSchedule, FaultSpec
+
+    phases: dict[str, dict[str, float]] = {}
+    for phase in ("clean", "fault"):
+        pipe = _slo_pipeline(pod_start_latency)
+        if phase == "fault":
+            schedule = ChaosSchedule(
+                pipe,
+                [FaultSpec("scrape_blackout", at=fault_at, duration=fault_duration)],
+            )
+            schedule.arm()
+        first_fired: dict[str, float] = {}
+        elapsed = 0.0
+        while elapsed < duration:
+            pipe.clock.advance(1.0)
+            elapsed += 1.0
+            for name in pipe.evaluator.firing_alerts():
+                if name.startswith("SLO"):
+                    first_fired.setdefault(name, elapsed)
+        phases[phase] = first_fired
+
+    fast = "SLOScrapeSuccessFastBurn"
+    slow = "SLOScrapeSuccessSlowBurn"
+
+    def detection(alert: str) -> float | None:
+        fired_at = phases["fault"].get(alert)
+        return None if fired_at is None else fired_at - fault_at
+
+    result = {
+        "duration": duration,
+        "fault_at": fault_at,
+        "fault_duration": fault_duration,
+        "clean_false_positives": sorted(phases["clean"]),
+        "fault_first_fired": dict(sorted(phases["fault"].items())),
+        "fast_detection_s": detection(fast),
+        "slow_detection_s": detection(slow),
+    }
+    result["ok"] = not result["clean_false_positives"] and (
+        result["fast_detection_s"] is not None
+    )
+    return result
+
+
+def render_slo_report(result: dict) -> str:
+    lines = [
+        "SLO burn-rate check (clean window + scrape blackout "
+        f"t={result['fault_at']:.0f}s for {result['fault_duration']:.0f}s):",
+        "",
+    ]
+    fps = result["clean_false_positives"]
+    lines.append(
+        "clean phase: no SLO alert fired"
+        if not fps
+        else f"clean phase: FALSE POSITIVE(S): {', '.join(fps)}"
+    )
+    if result["fault_first_fired"]:
+        for name, at in result["fault_first_fired"].items():
+            lines.append(f"fault phase: {name} first fired at t={at:.0f}s")
+    else:
+        lines.append("fault phase: no SLO alert fired")
+    for speed, key in (("fast/page", "fast_detection_s"), ("slow/ticket", "slow_detection_s")):
+        d = result[key]
+        lines.append(
+            f"scrape-success {speed} detection latency: "
+            + ("NEVER FIRED" if d is None else f"{d:.0f}s after injection")
+        )
+    lines.append("")
+    lines.append("verdict: " + ("OK" if result["ok"] else "SLO CONTRACT VIOLATED"))
+    return "\n".join(lines)
+
+
 def run_external_scenario(
     hpa_doc: dict,
     scenario: str = "spike",
@@ -412,6 +550,14 @@ def main(args) -> int:
         print(render_drill_report(result))
         return 0 if result["ok"] else 2
 
+    if args.scenario == "slo":
+        # score the SLO burn-rate alerts both ways: a clean window (any
+        # firing is a false positive) and a scrape-blackout window (the
+        # fast scrape-success alert not firing is a false negative)
+        result = run_slo_check(pod_start_latency=args.pod_start)
+        print(render_slo_report(result))
+        return 0 if result["ok"] else 2
+
     if args.scenario == "trace":
         # the spike scenario, fully traced: decision timeline with per-scale-
         # event metric lineage, propagation-latency summary, JSONL export.
@@ -526,6 +672,7 @@ if __name__ == "__main__":
             "chaos",
             "trace",
             "drill",
+            "slo",
         ],
     )
     parser.add_argument("--hpa", default="deploy/tpu-test-hpa.yaml")

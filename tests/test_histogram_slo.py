@@ -1,0 +1,435 @@
+"""Native histograms, exemplars, and SLO burn-rate alerting (ISSUE 5).
+
+Four contracts:
+
+- **quantile fidelity**: ``HistogramQuantile``'s classic bucket
+  interpolation tracks the exact ``obs/latency.percentile`` reference on
+  randomized observation sets, with error bounded by the width of the
+  buckets involved — plus the pinned boundary behavior (q=0, q=100, n=1)
+  of the reference itself.
+- **exposition round trip**: a histogram family encodes to OpenMetrics
+  text (_bucket/_sum/_count, le labels, +Inf) and parses back to the same
+  samples, exemplar trailers included.
+- **durability**: bucket series and their exemplars survive a WAL
+  kill/recover, through both the replay path and the snapshot path.
+- **SLO accounting + alerting**: the recorders turn source series into the
+  normalized slo_good_total/slo_events_total counters, and the Workbook
+  multiwindow burn alerts fire on a real blackout while staying silent on
+  a clean run (the full check lives in ``simulate slo``; the units here
+  drive the same machinery on hand-built counters).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+import pytest
+
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text, flatten, parse_text
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    HistogramQuantile,
+    RuleEvaluator,
+    bucket_quantile,
+)
+from k8s_gpu_hpa_tpu.metrics.schema import Exemplar, Histogram
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.metrics.wal import WriteAheadLog
+from k8s_gpu_hpa_tpu.obs.latency import histogram_quantiles, percentile
+from k8s_gpu_hpa_tpu.obs.slo import (
+    SLO_EVENTS_TOTAL,
+    SLO_GOOD_TOTAL,
+    SLODefinition,
+    SLORecorder,
+    burn_rate_alerts,
+    shipped_slo_alerts,
+    shipped_slos,
+)
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+# ---- percentile boundary pins (the exact reference) -------------------------
+
+
+def test_percentile_boundaries_pinned():
+    values = [5.0, 1.0, 3.0]
+    assert percentile(values, 0) == 1.0  # q=0 is the minimum
+    assert percentile(values, 100) == 5.0  # q=100 the maximum
+    assert percentile(values, -3) == 1.0  # clamped below
+    assert percentile(values, 250) == 5.0  # clamped above
+    # a single sample answers every quantile with itself (round(0.5)
+    # banker's-rounds to 0 — the case the old clamp covered by accident)
+    for q in (0, 1, 50, 99, 100):
+        assert percentile([7.5], q) == 7.5
+    assert percentile([], 50) is None
+
+
+def test_percentile_is_nearest_rank():
+    values = list(range(1, 101))  # 1..100
+    assert percentile(values, 50) == 50
+    assert percentile(values, 95) == 95
+    assert percentile(values, 1) == 1
+
+
+# ---- quantile fidelity: bucket interpolation vs the exact reference ---------
+
+BOUNDS = (5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0, 90.0, 120.0)
+
+
+def _bucket_span(value: float) -> tuple[float, float]:
+    """The [lower, upper] edges of the finite bucket holding ``value``."""
+    lo = 0.0
+    for hi in BOUNDS:
+        if value <= hi:
+            return lo, hi
+        lo = hi
+    return BOUNDS[-2], BOUNDS[-1]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("q", [0.0, 0.5, 0.95, 0.99, 1.0])
+def test_bucket_quantile_tracks_exact_percentile(seed, q):
+    """On observations inside the finite bucket range, the histogram
+    estimate lies within bucket width of the exact nearest-rank answer:
+    both land in the same or an adjacent bucket, so |est - exact| is
+    bounded by the sum of those two buckets' widths."""
+    rng = random.Random(seed)
+    n = rng.randrange(1, 200)
+    values = [rng.uniform(0.0, BOUNDS[-1]) for _ in range(n)]
+    hist = Histogram("signal_propagation_seconds", bounds=BOUNDS)
+    for v in values:
+        hist.observe(v)
+    est = bucket_quantile(hist.cumulative_buckets(), q)
+    exact = percentile(values, q * 100.0)
+    assert est is not None and exact is not None
+    lo_e, hi_e = _bucket_span(exact)
+    lo_s, hi_s = _bucket_span(est)
+    tolerance = (hi_e - lo_e) + (hi_s - lo_s)
+    assert abs(est - exact) <= tolerance, (
+        f"seed={seed} q={q}: estimate {est} vs exact {exact} "
+        f"(tolerance {tolerance})"
+    )
+
+
+def test_bucket_quantile_edge_semantics():
+    hist = Histogram("h", bounds=(1.0, 2.0, 4.0))
+    for v in (1.5, 1.7, 3.0):
+        hist.observe(v)
+    buckets = hist.cumulative_buckets()
+    # q=0 lands in the first NON-empty bucket (holding the minimum), never
+    # interpolates inside empty bucket 0
+    assert 1.0 <= bucket_quantile(buckets, 0.0) <= 2.0
+    # a rank in +Inf clamps to the last finite bound
+    hist.observe(99.0)
+    assert bucket_quantile(hist.cumulative_buckets(), 1.0) == 4.0
+    # empty histogram / missing +Inf: no answer
+    assert bucket_quantile([], 0.5) is None
+    assert bucket_quantile([(1.0, 3.0)], 0.5) is None
+    assert bucket_quantile(Histogram("e").cumulative_buckets(), 0.5) is None
+
+
+def test_histogram_quantile_expr_groups_by_non_le_labels():
+    """The TSDB-side node: bucket series land as plain series with le
+    labels; HistogramQuantile groups them back per label set."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    hist = Histogram("rpc_seconds", bounds=(1.0, 2.0))
+    for v, tgt in ((0.5, "a"), (1.5, "a"), (1.5, "a"), (0.2, "b")):
+        hist.observe(v, target=tgt)
+    for name, sample in flatten([hist.family()]):
+        db.append(name, sample.labels, sample.value)
+    out = HistogramQuantile(0.5, "rpc_seconds").evaluate(db)
+    by_labels = {dict(s.labels)["target"]: s.value for s in out}
+    assert set(by_labels) == {"a", "b"}
+    assert by_labels["a"] == pytest.approx(
+        bucket_quantile(hist.cumulative_buckets((("target", "a"),)), 0.5)
+    )
+    assert 0.0 <= by_labels["b"] <= 1.0
+    assert (
+        HistogramQuantile(0.95, "rpc_seconds", {"target": "a"}).promql()
+        == 'histogram_quantile(0.95, rpc_seconds_bucket{target="a"})'
+    )
+
+
+def test_histogram_quantiles_helper_reads_live_histogram():
+    hist = Histogram("x_seconds", bounds=BOUNDS)
+    assert histogram_quantiles(hist) == {"p50": None, "p95": None, "p99": None}
+    for v in (12.0, 14.0, 55.0):
+        hist.observe(v)
+    out = histogram_quantiles(hist)
+    assert 10.0 <= out["p50"] <= 15.0
+    assert 45.0 <= out["p99"] <= 60.0
+
+
+# ---- exposition round trip with exemplars -----------------------------------
+
+
+def test_histogram_exposition_round_trip_preserves_exemplars():
+    hist = Histogram("hpa_sync_latency_seconds", "sync cost")
+    hist.observe(0.003, Exemplar(0.003, trace_id=7, span_id=7, ts=12.5))
+    hist.observe(0.3, Exemplar(0.3, trace_id=9, span_id=9))
+    text = encode_text([hist.family()])
+    assert 'le="+Inf"' in text
+    assert '# {trace_id="7",span_id="7"} 0.003 12.5' in text
+    fams = parse_text(text)
+    assert len(fams) == 1 and fams[0].type == "histogram"
+    back = {
+        (name, s.labels, s.suffix): s for name, s in flatten(fams)
+    }
+    orig = {
+        (name, s.labels, s.suffix): s for name, s in flatten([hist.family()])
+    }
+    assert set(back) == set(orig)
+    for key, s in orig.items():
+        assert back[key].value == s.value
+        if s.exemplar is not None:
+            got = back[key].exemplar
+            assert got is not None
+            assert (got.trace_id, got.span_id, got.value, got.ts) == (
+                s.exemplar.trace_id,
+                s.exemplar.span_id,
+                s.exemplar.value,
+                s.exemplar.ts,
+            )
+
+
+# ---- durability: buckets + exemplars through WAL kill/recover ---------------
+
+BUCKET = "signal_propagation_seconds_bucket"
+LBL = (("le", "30"),)
+
+
+def _populate_histogram_series(db: TimeSeriesDB, upto: int) -> None:
+    for i in range(upto):
+        ts = float(i)
+        db.append(
+            BUCKET,
+            LBL,
+            float(i + 1),
+            ts=ts,
+            exemplar=Exemplar(12.0, trace_id=100 + i, span_id=100 + i, ts=ts),
+        )
+        db.append("signal_propagation_seconds_count", (), float(i + 1), ts=ts)
+        db.append("signal_propagation_seconds_sum", (), 12.0 * (i + 1), ts=ts)
+
+
+def test_wal_recover_preserves_bucket_series_and_exemplars(tmp_path):
+    wal = WriteAheadLog(tmp_path / "wal", segment_max_records=16)
+    db = TimeSeriesDB(VirtualClock(), wal=wal)
+    _populate_histogram_series(db, 20)
+    # the process dies here; a new one replays the log
+    recovered = TimeSeriesDB.recover(WriteAheadLog(tmp_path / "wal"), VirtualClock())
+    vec = recovered.instant_vector(BUCKET, {}, at=19.0)
+    assert [(s.labels, s.value) for s in vec] == [(LBL, 20.0)]
+    count = recovered.instant_vector("signal_propagation_seconds_count", {}, at=19.0)
+    assert [s.value for s in count] == [20.0]
+    ex = recovered.exemplar(BUCKET, LBL)
+    assert ex is not None and (ex.trace_id, ex.span_id) == (119, 119)
+    assert ex.value == 12.0 and ex.ts == 19.0
+
+
+def test_snapshot_path_preserves_bucket_series_and_exemplars(tmp_path):
+    wal = WriteAheadLog(tmp_path / "wal", segment_max_records=8)
+    db = TimeSeriesDB(VirtualClock(), wal=wal)
+    _populate_histogram_series(db, 10)
+    db.snapshot()  # subsumes the segments: recovery must read the snapshot
+    _populate_histogram_series_tail(db)
+    recovered = TimeSeriesDB.recover(WriteAheadLog(tmp_path / "wal"), VirtualClock())
+    assert recovered.last_recovery["snapshot_restored"] is True
+    vec = recovered.instant_vector(BUCKET, {"le": "30"}, at=10.0)
+    assert [s.value for s in vec] == [11.0]
+    ex = recovered.exemplar(BUCKET, LBL)
+    assert ex is not None and ex.span_id == 555
+
+
+def _populate_histogram_series_tail(db: TimeSeriesDB) -> None:
+    db.append(
+        BUCKET,
+        LBL,
+        11.0,
+        ts=10.0,
+        exemplar=Exemplar(28.0, trace_id=555, span_id=555, ts=10.0),
+    )
+
+
+# ---- SLO recorders: source series -> normalized budget counters -------------
+
+
+def _gauge_slo() -> SLODefinition:
+    return SLODefinition(
+        name="scrape-success",
+        objective=0.99,
+        description="scrapes succeed",
+        source="gauge",
+        good_series="up",
+    )
+
+
+def test_slo_recorder_gauge_mode_counts_up_events():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    rec = SLORecorder(_gauge_slo())
+    labels = dict(rec.slo.labels)
+    # nothing written while the source is absent (a young pipeline must not
+    # mint zero-total counters the burn expr would divide by)
+    assert rec.evaluate_into(db) == 0
+    assert db.latest(SLO_EVENTS_TOTAL, labels) is None
+    for t in range(3):
+        db.append("up", (("target", "a"),), 1.0, ts=clock.now())
+        db.append("up", (("target", "b"),), 1.0 if t < 2 else 0.0, ts=clock.now())
+        rec.evaluate_into(db)
+        clock.advance(1.0)
+    assert db.latest(SLO_EVENTS_TOTAL, labels) == 6.0
+    assert db.latest(SLO_GOOD_TOTAL, labels) == 5.0  # one failed scrape
+
+
+def test_slo_recorder_counter_mode_is_monotonic_and_seeds_from_db():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    slo = next(s for s in shipped_slos() if s.source == "counter")
+    labels = dict(SLORecorder(slo).slo.labels)
+    rec = SLORecorder(slo)
+    db.append(slo.good_series, (("le", "30"),), 3.0, ts=clock.now())
+    db.append(slo.total_series, (), 4.0, ts=clock.now())
+    rec.evaluate_into(db)
+    assert db.latest(SLO_GOOD_TOTAL, labels) == 3.0
+    assert db.latest(SLO_EVENTS_TOTAL, labels) == 4.0
+    # a fresh recorder over a recovered DB seeds from the persisted
+    # counters instead of restarting the budget from zero
+    clock.advance(1.0)
+    rec2 = SLORecorder(slo)
+    db.append(slo.good_series, (("le", "30"),), 3.0, ts=clock.now())
+    db.append(slo.total_series, (), 5.0, ts=clock.now())
+    rec2.evaluate_into(db)
+    assert db.latest(SLO_GOOD_TOTAL, labels) == 3.0
+    assert db.latest(SLO_EVENTS_TOTAL, labels) == 5.0
+
+
+# ---- burn-rate alerts: fire on blackout, silent on clean --------------------
+
+
+def _drive(db, clock, evaluator, seconds, good_rate):
+    """Advance ``seconds`` ticks writing one event/s, ``good_rate`` of them
+    good, into hand-built SLO counters."""
+    labels = (("slo", "scrape-success"),)
+    good = db.latest(SLO_GOOD_TOTAL, dict(labels)) or 0.0
+    total = db.latest(SLO_EVENTS_TOTAL, dict(labels)) or 0.0
+    for _ in range(int(seconds)):
+        total += 1.0
+        good += good_rate
+        db.append(SLO_GOOD_TOTAL, labels, good, ts=clock.now())
+        db.append(SLO_EVENTS_TOTAL, labels, total, ts=clock.now())
+        evaluator.evaluate_once()
+        clock.advance(1.0)
+
+
+def test_burn_alerts_fire_on_blackout_and_stay_silent_on_clean():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    alerts = burn_rate_alerts(_gauge_slo())
+    assert [a.labels["burn"] for a in alerts] == ["fast", "slow"]
+    evaluator = RuleEvaluator(db, [], alerts=alerts)
+    # clean: a perfectly healthy counter stream never fires
+    _drive(db, clock, evaluator, 400, good_rate=1.0)
+    assert evaluator.firing_alerts() == []
+    # blackout: every event bad — burn rises over both windows of each
+    # pair (the run is younger than 1h, so the long windows degrade to
+    # since-start: 90 bad of 490 total crosses 14.4x on a 0.99 objective)
+    _drive(db, clock, evaluator, 90, good_rate=0.0)
+    firing = evaluator.firing_alerts()
+    assert "SLOScrapeSuccessFastBurn" in firing
+    assert "SLOScrapeSuccessSlowBurn" in firing
+    # recovery: healthy traffic dilutes the short windows first; the fast
+    # pair un-fires once the 5m window clears its threshold
+    _drive(db, clock, evaluator, 400, good_rate=1.0)
+    assert "SLOScrapeSuccessFastBurn" not in evaluator.firing_alerts()
+
+
+def test_burn_rate_no_traffic_is_no_evidence():
+    """An absent or unmoving total counter yields an EMPTY burn vector —
+    the alert cannot fire on a pipeline that simply has no events."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    alerts = burn_rate_alerts(_gauge_slo())
+    evaluator = RuleEvaluator(db, [], alerts=alerts)
+    evaluator.evaluate_once()
+    assert evaluator.firing_alerts() == []
+    labels = (("slo", "scrape-success"),)
+    db.append(SLO_GOOD_TOTAL, labels, 5.0, ts=clock.now())
+    db.append(SLO_EVENTS_TOTAL, labels, 5.0, ts=clock.now())
+    clock.advance(30.0)
+    evaluator.evaluate_once()  # counters present but did not move
+    assert evaluator.firing_alerts() == []
+
+
+def test_shipped_slo_alert_names_and_thresholds():
+    alerts = {a.alert: a for a in shipped_slo_alerts()}
+    assert set(alerts) == {
+        "SLOSignalPropagationFastBurn",
+        "SLOSignalPropagationSlowBurn",
+        "SLOScrapeSuccessFastBurn",
+        "SLOScrapeSuccessSlowBurn",
+    }
+    for name, a in alerts.items():
+        assert a.labels["severity"] == (
+            "critical" if a.labels["burn"] == "fast" else "warning"
+        )
+        # both windows of the pair must cross: the expr is an AND of two
+        # threshold comparisons over the same normalized counters
+        promql = a.expr.promql()
+        assert " and on() " in promql
+        assert SLO_GOOD_TOTAL in promql and SLO_EVENTS_TOTAL in promql
+
+
+def test_slo_definition_validation():
+    with pytest.raises(ValueError):
+        SLODefinition(
+            name="bad", objective=1.5, description="", source="gauge",
+            good_series="up",
+        )
+    with pytest.raises(ValueError):
+        SLODefinition(
+            name="bad", objective=0.9, description="", source="event",
+            good_series="up",
+        )
+    with pytest.raises(ValueError):
+        # counter mode needs an explicit total series
+        SLODefinition(
+            name="bad", objective=0.9, description="", source="counter",
+            good_series="x_bucket",
+        )
+
+
+# ---- the full check: clean window silent, blackout detected -----------------
+
+
+@pytest.mark.slow
+def test_simulate_slo_check_end_to_end():
+    from k8s_gpu_hpa_tpu.simulate import render_slo_report, run_slo_check
+
+    result = run_slo_check()
+    assert result["ok"], result
+    assert result["clean_false_positives"] == []
+    assert result["fast_detection_s"] is not None
+    # the blackout is total: detection must beat the scenario's remaining
+    # runtime by a wide margin (observed ~20s fast / ~7s slow)
+    assert result["fast_detection_s"] <= 60.0
+    assert result["slow_detection_s"] <= 60.0
+    report = render_slo_report(result)
+    assert "verdict: OK" in report
+    assert "FALSE POSITIVE" not in report
+
+
+def test_propagation_report_carries_histogram_quantiles():
+    """With selfmetrics, the report gains hist_scale_latency_* keys read off
+    the live histogram; without, the old exact-only shape is unchanged."""
+    from k8s_gpu_hpa_tpu.obs import PipelineSelfMetrics
+    from k8s_gpu_hpa_tpu.obs.latency import propagation_report
+
+    base = propagation_report([])
+    assert "hist_scale_latency_p95" not in base
+    sm = PipelineSelfMetrics()
+    for v in (8.0, 12.0, 33.0):
+        sm.observe_propagation(v, span_id=None)
+    report = propagation_report([], selfmetrics=sm)
+    assert 5.0 <= report["hist_scale_latency_p50"] <= 15.0
+    assert 30.0 <= report["hist_scale_latency_p99"] <= 45.0

@@ -181,7 +181,11 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
     dash = json.loads(doc["data"]["tpu-hpa-pipeline.json"])
 
     from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
-    from k8s_gpu_hpa_tpu.obs.selfmetrics import SELF_METRIC_NAMES
+    from k8s_gpu_hpa_tpu.obs.selfmetrics import (
+        SELF_HISTOGRAM_SERIES,
+        SELF_METRIC_NAMES,
+    )
+    from k8s_gpu_hpa_tpu.obs.slo import SLO_EVENTS_TOTAL, SLO_GOOD_TOTAL
 
     rule_doc = load("tpu-test-prometheusrule.yaml")
     recorded = {
@@ -217,6 +221,11 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         # pipeline self-metrics (obs/selfmetrics.py, the pipeline-self
         # scrape target) — single-sourced so a rename breaks this test
         | set(SELF_METRIC_NAMES)
+        # histogram self-metrics expand to _bucket/_sum/_count series,
+        # and the SLO recorders maintain the normalized budget counters
+        # (obs/slo.py) the burn panels and burn alerts read
+        | set(SELF_HISTOGRAM_SERIES)
+        | {SLO_GOOD_TOTAL, SLO_EVENTS_TOTAL}
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
     assert exprs, "dashboard has no queries"
@@ -225,9 +234,10 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
             tok
             for tok in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr)
             if tok.startswith(
-                ("tpu_", "kube_", "ALERTS", "quantum_operator_")
+                ("tpu_", "kube_", "ALERTS", "quantum_operator_", "slo_")
             )
             or tok in SELF_METRIC_NAMES
+            or tok in SELF_HISTOGRAM_SERIES
         }
         assert names, f"no metric reference in {expr!r}"
         assert names <= known, f"unknown series in {expr!r}: {names - known}"

@@ -30,10 +30,24 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
 from k8s_gpu_hpa_tpu.obs.selfmetrics import (  # noqa: E402
+    ADAPTER_QUERY_LATENCY,
     HPA_DECISION_TOTAL,
     HPA_SYNC_DURATION,
+    HPA_SYNC_LATENCY,
+    RULE_EVAL_LATENCY,
     RULE_EVAL_STALENESS,
     SCRAPE_DURATION,
+    SCRAPE_LATENCY,
+    SIGNAL_PROPAGATION,
+)
+from k8s_gpu_hpa_tpu.obs.slo import (  # noqa: E402
+    FAST_BURN,
+    FAST_WINDOWS,
+    SLO_EVENTS_TOTAL,
+    SLO_GOOD_TOTAL,
+    SLOW_BURN,
+    SLOW_WINDOWS,
+    shipped_slos,
 )
 
 HPA_TARGET_PERCENT = 40  # deploy/tpu-test-hpa.yaml target value
@@ -103,6 +117,63 @@ def _ts_panel(
         },
         "targets": targets,
     }
+
+
+def _window(seconds: float) -> str:
+    """A PromQL range-vector duration for a whole number of seconds."""
+    for unit, div in (("h", 3600), ("m", 60), ("s", 1)):
+        if seconds % div == 0:
+            return f"{int(seconds // div)}{unit}"
+    return f"{int(seconds)}s"
+
+
+def _quantile_targets(hist: str) -> list[dict]:
+    """p50/p95/p99 targets over one histogram's bucket rates — the classic
+    histogram_quantile read every latency panel uses."""
+    return [
+        _target(
+            f"histogram_quantile({q}, sum by(le) "
+            f"(rate({hist}_bucket[5m])))",
+            f"p{round(q * 100):g}",
+            refid,
+        )
+        for q, refid in ((0.50, "A"), (0.95, "B"), (0.99, "C"))
+    ]
+
+
+def _heatmap_panel(pid: int, title: str, x: int, y: int, hist: str, desc: str) -> dict:
+    """A latency heatmap straight off the histogram's bucket rates; Grafana's
+    native heatmap type with format=heatmap un-accumulates the le buckets."""
+    target = _target(
+        f"sum by(le) (rate({hist}_bucket[5m]))", "{{le}}", "A"
+    )
+    target["format"] = "heatmap"
+    return {
+        "id": pid,
+        "type": "heatmap",
+        "title": title,
+        "description": desc,
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "fieldConfig": {"defaults": {"custom": {"scaleDistribution": {"type": "linear"}}}, "overrides": []},
+        "options": {
+            "calculate": False,
+            "yAxis": {"unit": "s"},
+            "color": {"mode": "scheme", "scheme": "Spectral", "steps": 64},
+            "tooltip": {"mode": "single", "showColorScale": True},
+        },
+        "targets": [target],
+    }
+
+
+def _burn_expr(slo_name: str, objective: float, window_s: float) -> str:
+    """Error-budget burn rate over one window: observed error ratio divided
+    by the budget (1 - objective) — the Workbook's multiwindow alert input,
+    off the normalized slo_good_total/slo_events_total counters."""
+    w = _window(window_s)
+    good = f'increase({SLO_GOOD_TOTAL}{{slo="{slo_name}"}}[{w}])'
+    total = f'increase({SLO_EVENTS_TOTAL}{{slo="{slo_name}"}}[{w}])'
+    return f"(1 - ({good} / {total})) / {1 - objective:g}"
 
 
 def build_dashboard() -> dict:
@@ -435,6 +506,117 @@ def build_dashboard() -> dict:
             unit="s",
             threshold=10,
         ),
+        # ---- latency distributions (histogram self-metrics): the tail that
+        # predicts a missed scale-up, not just the last value ----
+        _heatmap_panel(
+            16,
+            "Signal propagation heatmap (change → scale event)",
+            0,
+            64,
+            SIGNAL_PROPAGATION,
+            "Bucket rates of the end-to-end propagation histogram: each "
+            "column is the distribution of change→scale latencies over 5m.  "
+            "Mass drifting into the ≥30s rows is budget burn in the making — "
+            "click any cell's exemplar to open the exact trace that was slow.",
+        ),
+        _ts_panel(
+            17,
+            "Signal propagation quantiles",
+            12,
+            64,
+            _quantile_targets(SIGNAL_PROPAGATION),
+            "p50/p95/p99 of workload change → scale event, off the same "
+            "buckets as the heatmap.  The red line is the propagation SLO "
+            "budget (30s): p95 crossing it precedes the burn-rate alerts.",
+            unit="s",
+            threshold=30,
+        ),
+        _ts_panel(
+            18,
+            "Pipeline self: scrape latency quantiles",
+            0,
+            72,
+            _quantile_targets(SCRAPE_LATENCY),
+            "Scrape duration distribution, all targets pooled (the per-target "
+            "gauge panel keeps the breakdown).  A fattening p99 with a flat "
+            "p50 is one slow target hiding inside a healthy fleet.",
+            unit="s",
+        ),
+        _ts_panel(
+            19,
+            "Pipeline self: rule-eval latency quantiles",
+            12,
+            72,
+            _quantile_targets(RULE_EVAL_LATENCY),
+            "Full recording-rule evaluation cost per pass (skipped "
+            "incremental evals are not observed).  Growth tracks series "
+            "cardinality — this is the panel that says the rules are why "
+            "the signal is late.",
+            unit="s",
+        ),
+        _ts_panel(
+            20,
+            "Pipeline self: HPA sync latency quantiles",
+            0,
+            80,
+            _quantile_targets(HPA_SYNC_LATENCY),
+            "HPA sync pass duration distribution (metric fetch + decision + "
+            "scale patch).  Compare against the sync-duration gauge panel: "
+            "the gauge shows now, the quantiles show how bad it gets.",
+            unit="s",
+        ),
+        _ts_panel(
+            21,
+            "Pipeline self: adapter query latency quantiles",
+            12,
+            80,
+            _quantile_targets(ADAPTER_QUERY_LATENCY),
+            "Custom-metrics adapter query duration distribution — the L4 "
+            "joint's cost.  Every p99 bucket carries an exemplar linking to "
+            "the adapter_query span that produced it.",
+            unit="s",
+        ),
+        # ---- SLO error-budget burn (obs/slo.py): the paging signal ----
+        *[
+            _ts_panel(
+                22 + i,
+                f"SLO burn rate: {slo.name}",
+                12 * (i % 2),
+                88 + 8 * (i // 2),
+                [
+                    _target(
+                        _burn_expr(slo.name, slo.objective, FAST_WINDOWS[0]),
+                        f"burn {_window(FAST_WINDOWS[0])}",
+                        "A",
+                    ),
+                    _target(
+                        _burn_expr(slo.name, slo.objective, FAST_WINDOWS[1]),
+                        f"burn {_window(FAST_WINDOWS[1])}",
+                        "B",
+                    ),
+                    _target(
+                        _burn_expr(slo.name, slo.objective, SLOW_WINDOWS[0]),
+                        f"burn {_window(SLOW_WINDOWS[0])}",
+                        "C",
+                    ),
+                    _target(
+                        _burn_expr(slo.name, slo.objective, SLOW_WINDOWS[1]),
+                        f"burn {_window(SLOW_WINDOWS[1])}",
+                        "D",
+                    ),
+                ],
+                f"{slo.description}  Error-budget burn rate per window "
+                f"(objective {slo.objective:g}): the fast pair "
+                f"({_window(FAST_WINDOWS[0])}/{_window(FAST_WINDOWS[1])}) "
+                f"pages above {FAST_BURN:g}, the slow pair "
+                f"({_window(SLOW_WINDOWS[0])}/{_window(SLOW_WINDOWS[1])}) "
+                f"tickets above {SLOW_BURN:g} — both windows of a pair must "
+                "cross (the Workbook multiwindow rule, "
+                "deploy/tpu-test-prometheusrule.yaml).",
+                threshold=FAST_BURN,
+            )
+            for i, slo in enumerate(shipped_slos())
+        ],
     ]
     return {
         "title": "TPU HPA pipeline",

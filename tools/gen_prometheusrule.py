@@ -26,6 +26,7 @@ from k8s_gpu_hpa_tpu.metrics.schema import (
     TPU_HBM_BW_UTIL,
     TPU_TENSORCORE_UTIL,
 )
+from k8s_gpu_hpa_tpu.obs.slo import shipped_slo_alerts
 
 HEADER = """\
 # L3 recording rule: defines the autoscale metric.
@@ -149,19 +150,36 @@ def render() -> str:
         "      rules:\n"
     )
     for alert in shipped_alert_rules():
-        out.append(f"        - alert: {alert.alert}\n")
-        out.append(f"          expr: {alert.expr.promql()}\n")
-        if alert.for_seconds:
-            out.append(f"          for: {int(alert.for_seconds)}s\n")
-        if alert.labels:
-            out.append("          labels:\n")
-            for k, v in alert.labels.items():
-                out.append(f"            {k}: {v}\n")
-        if alert.annotations:
-            out.append("          annotations:\n")
-            for k, v in alert.annotations.items():
-                out.append(f"            {k}: >-\n")
-                out.append(f"              {v}\n")
+        out.append(_render_alert(alert))
+    out.append(
+        "    # SLO error-budget burn-rate alerts (obs/slo.py): Workbook\n"
+        "    # multiwindow pairs over the normalized slo_good_total /\n"
+        "    # slo_events_total counters the SLO recorders maintain — the\n"
+        "    # fast pair pages, the slow pair tickets, and a single-window\n"
+        "    # spike that the long window hasn't confirmed stays silent\n"
+        "    - name: tpu-slo-burn\n"
+        "      interval: 1s\n"
+        "      rules:\n"
+    )
+    for alert in shipped_slo_alerts():
+        out.append(_render_alert(alert))
+    return "".join(out)
+
+
+def _render_alert(alert) -> str:
+    out = [f"        - alert: {alert.alert}\n"]
+    out.append(f"          expr: {alert.expr.promql()}\n")
+    if alert.for_seconds:
+        out.append(f"          for: {int(alert.for_seconds)}s\n")
+    if alert.labels:
+        out.append("          labels:\n")
+        for k, v in alert.labels.items():
+            out.append(f"            {k}: {v}\n")
+    if alert.annotations:
+        out.append("          annotations:\n")
+        for k, v in alert.annotations.items():
+            out.append(f"            {k}: >-\n")
+            out.append(f"              {v}\n")
     return "".join(out)
 
 

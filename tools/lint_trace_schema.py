@@ -13,13 +13,22 @@ any file that passes:
   kind the schema allows for that edge (no dangling or cross-layer links);
 - no span ends before it starts, and no span links to itself.
 
+It also lints exemplars: given a metrics exposition alongside the trace
+export, every histogram bucket exemplar must carry trace_id/span_id labels
+that resolve to spans IN THE EXPORT (and to each other — the tracer is
+single-process, so trace_id == span_id).  A dangling exemplar is a broken
+debugging link at exactly the moment it matters: clicking through from a
+p99 bucket to the trace that produced it.
+
 Usage:
     python tools/lint_trace_schema.py TRACE.jsonl [TRACE2.jsonl ...]
+    python tools/lint_trace_schema.py --exemplars METRICS.txt TRACE.jsonl
     python tools/lint_trace_schema.py --selfcheck
 
 ``--selfcheck`` runs a short traced simulation in-process, exports it, and
-lints the result — the zero-fixture mode tools/tier1.sh runs so the real
-emitters are checked against the schema on every verify pass.
+lints the result — spans AND the self-metrics exposition's exemplars — the
+zero-fixture mode tools/tier1.sh runs so the real emitters are checked
+against the schema on every verify pass.
 """
 
 from __future__ import annotations
@@ -71,6 +80,45 @@ def lint_spans(spans: list[Span]) -> list[str]:
     return errors
 
 
+def lint_exemplars(text: str, spans: list[Span]) -> list[str]:
+    """Every broken exemplar link in a metrics exposition, checked against a
+    trace export: each bucket exemplar's trace_id/span_id must resolve to a
+    span in ``spans`` and agree with each other (single-process tracer).
+    A ``# {`` trailer the parser had to drop is itself a finding — a
+    malformed exemplar is invisible to every downstream consumer."""
+    from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+
+    errors: list[str] = []
+    by_id = {s.span_id: s for s in spans}
+    seen = 0
+    for fam in parse_text(text):
+        for sample in fam.samples:
+            ex = sample.exemplar
+            if ex is None:
+                continue
+            seen += 1
+            where = fam.name + sample.suffix
+            if ex.trace_id != ex.span_id:
+                errors.append(
+                    f"{where}: exemplar trace_id {ex.trace_id} != span_id "
+                    f"{ex.span_id} (single-process tracer: they must agree)"
+                )
+            if ex.span_id not in by_id:
+                errors.append(
+                    f"{where}: exemplar span_id {ex.span_id} resolves to no "
+                    "span in the trace export"
+                )
+    trailers = sum(1 for line in text.splitlines() if " # {" in line)
+    if trailers != seen:
+        errors.append(
+            f"{trailers - seen} exemplar trailer(s) present in the text but "
+            "dropped by the parser (malformed labels/value)"
+        )
+    if seen == 0 and not errors:
+        errors.append("exposition carries no exemplars at all")
+    return errors
+
+
 def lint_file(path: str | Path) -> list[str]:
     try:
         spans = read_jsonl(path)
@@ -113,16 +161,26 @@ def _selfcheck() -> int:
     try:
         tracer.write_jsonl(path)
         errors = lint_file(path)
+        exported = read_jsonl(path)
     finally:
         path.unlink(missing_ok=True)
+    # the same pipeline's self-metrics exposition must link back into the
+    # export it just produced — the exemplar round trip, live
+    errors += lint_exemplars(pipe.selfmetrics.exposition(), exported)
     for err in errors:
         print(f"selfcheck: {err}")
     if errors:
         return 1
     kinds = sorted({s.kind for s in tracer.spans})
+    n_ex = sum(
+        1
+        for line in pipe.selfmetrics.exposition().splitlines()
+        if " # {" in line
+    )
     print(
         f"selfcheck ok: {len(tracer.spans)} spans "
-        f"({', '.join(kinds)}) all match the schema"
+        f"({', '.join(kinds)}) all match the schema; "
+        f"{n_ex} exemplars all resolve into the export"
     )
     return 0
 
@@ -133,6 +191,19 @@ def main(argv: list[str]) -> int:
         return 2
     if argv == ["--selfcheck"]:
         return _selfcheck()
+    if argv and argv[0] == "--exemplars":
+        if len(argv) != 3:
+            print("usage: --exemplars METRICS.txt TRACE.jsonl", file=sys.stderr)
+            return 2
+        text = Path(argv[1]).read_text()
+        spans = read_jsonl(argv[2])
+        errors = lint_exemplars(text, spans)
+        for err in errors:
+            print(f"{argv[1]}: {err}")
+        if not errors:
+            n = sum(1 for line in text.splitlines() if " # {" in line)
+            print(f"{argv[1]}: {n} exemplars all resolve into {argv[2]}")
+        return 1 if errors else 0
     rc = 0
     for arg in argv:
         errors = lint_file(arg)

@@ -8,7 +8,8 @@
 # tests now skip cleanly instead of erroring on hosts without cmake).
 set -o pipefail
 # trace-schema lint: the live emitters must still speak obs/schema.py's span
-# table (runs a short traced sim in-process and lints its JSONL export)
+# table (runs a short traced sim in-process and lints its JSONL export), and
+# every self-metrics histogram exemplar must resolve into that export
 python tools/lint_trace_schema.py --selfcheck || exit 1
 # sim_scale smoke: the fleet-scale metrics plane must stay fast (virtual/wall
 # speedup floor) and bounded (retention must keep trimming); small sizing —
