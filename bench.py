@@ -1723,18 +1723,70 @@ def run_rung_sim_scale() -> dict:
     path, and incremental rule eval hold at fleet size.  Wall time is the
     measured quantity here, so TIME_SCALE shrinks the *population*, not
     the clock constants."""
+    from k8s_gpu_hpa_tpu import perfgates
     from k8s_gpu_hpa_tpu.control.scale_harness import run_fleet_scale
 
     if TIME_SCALE == 1.0:
-        result = run_fleet_scale(targets=1000, horizon_s=3600.0)
-        floor = 1000.0
+        result = run_fleet_scale(
+            targets=perfgates.SIM_SCALE_TARGETS,
+            horizon_s=perfgates.SIM_SCALE_HORIZON_S,
+        )
+        floor = perfgates.SIM_SCALE_MIN_SPEEDUP
     else:  # smoke sizing: same code paths, ~20x less work
-        result = run_fleet_scale(targets=200, horizon_s=600.0)
-        floor = 100.0
+        result = run_fleet_scale(
+            targets=perfgates.SIM_SCALE_SMOKE_TARGETS,
+            horizon_s=perfgates.SIM_SCALE_SMOKE_HORIZON_S,
+        )
+        floor = perfgates.SIM_SCALE_SMOKE_MIN_SPEEDUP
     result["mode"] = "virtual"
     result["metric"] = "fleet-scale metrics plane (virtual s per wall s)"
     result["speedup_floor"] = floor
     result["meets_floor"] = result["speedup"] >= floor
+    return result
+
+
+def run_rung_sim_scale_10k() -> dict:
+    """Sharded federation rung (metrics/federation.py + scale_harness):
+    10,000 synthetic targets split across 8 hash-ring scraper shards, each
+    shard a Prometheus-agent-style scraper over its own columnar TSDB with
+    local sum/count pre-reductions, federated into the global view the HPA
+    reads, driven over a 1-hour virtual horizon.  Gates (perfgates.py):
+    Gorilla columns >= 4x denser than the 16-byte uncompressed point,
+    fleet-query p95 within the 3 ms budget (2x the r03 unsharded 1000-series
+    baseline), the appends/sec ingest floor, plus the ring invariants
+    (disjoint shard target sets whose union covers the fleet)."""
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.control.scale_harness import run_fleet_scale
+
+    if TIME_SCALE == 1.0:
+        result = run_fleet_scale(
+            targets=perfgates.SIM_SCALE_10K_TARGETS,
+            horizon_s=perfgates.SIM_SCALE_10K_HORIZON_S,
+            shards=perfgates.SIM_SCALE_10K_SHARDS,
+        )
+        floor = perfgates.SIM_SCALE_10K_MIN_SPEEDUP
+    else:  # smoke sizing: same code paths, ~10x less work
+        result = run_fleet_scale(
+            targets=perfgates.SIM_SCALE_10K_SMOKE_TARGETS,
+            horizon_s=perfgates.SIM_SCALE_10K_SMOKE_HORIZON_S,
+            shards=perfgates.SIM_SCALE_10K_SMOKE_SHARDS,
+        )
+        floor = perfgates.SIM_SCALE_10K_SMOKE_MIN_SPEEDUP
+    result["mode"] = "virtual"
+    result["metric"] = "sharded 10k-target federation plane (virtual s per wall s)"
+    result["speedup_floor"] = floor
+    result["meets_floor"] = result["speedup"] >= floor
+    result["compression_floor"] = perfgates.MIN_COMPRESSION_RATIO
+    result["query_p95_budget_ms"] = perfgates.MAX_FLEET_QUERY_P95_MS
+    result["appends_per_sec_floor"] = perfgates.MIN_APPENDS_PER_SEC
+    result["ok"] = (
+        result["meets_floor"]
+        and result["compression_ratio"] >= perfgates.MIN_COMPRESSION_RATIO
+        and result["query_p95_ms"] <= perfgates.MAX_FLEET_QUERY_P95_MS
+        and result["appends_per_sec"] >= perfgates.MIN_APPENDS_PER_SEC
+        and result["shards_disjoint"]
+        and result["shards_cover_fleet"]
+    )
     return result
 
 
@@ -2138,6 +2190,7 @@ def main() -> None:
             ("signal_latency", run_rung_signal_latency),
             ("slo_burn", run_rung_slo_burn),
             ("sim_scale", run_rung_sim_scale),
+            ("sim_scale_10k", run_rung_sim_scale_10k),
             ("recovery_drill", run_rung_recovery_drill),
         ):
             log(f"rung {name}:")

@@ -231,6 +231,13 @@ def main(argv: list[str] | None = None) -> int:
         help="comma list of components --scenario drill restarts "
         "(tsdb,hpa,adapter,wal); default all",
     )
+    sim.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="run the scenario against a sharded scrape plane with N "
+        "hash-ring scraper shards (0 = single scraper)",
+    )
 
     genm = sub.add_parser(
         "gen-manifests", help="check or write the generated shipped manifests"

@@ -13,6 +13,13 @@ with Kubernetes objects via their resource labels (the recorded series carries
 cuda-test-prometheusrule.yaml:14-16), and serve instant values on the
 ``custom.metrics.k8s.io/v1beta1`` contract the HPA polls
 (probe: ``kubectl get --raw /apis/custom.metrics.k8s.io/v1beta1``, README.md:98-102).
+
+The adapter reads only through the TSDB's ``instant_vector``/``latest``
+surface, so it is oblivious to the storage behind it: on a sharded
+pipeline the ``db`` handed in is a ``FederatedTSDB``
+(metrics/federation.py) and the same single-series read fans out across
+shard DBs — recorded aggregates live in the global member, so the common
+case never touches a shard.
 """
 
 from __future__ import annotations

@@ -67,6 +67,7 @@ class AutoscalingPipeline:
         structured_scrapes: bool = True,
         wal=None,
         checkpoint_store=None,
+        scrape_shards: int = 0,
     ):
         self.cluster = cluster
         self.deployment = deployment
@@ -94,13 +95,37 @@ class AutoscalingPipeline:
             cluster.tracer = tracer
             self.selfmetrics = PipelineSelfMetrics(clock=clock)
 
-        self.db = TimeSeriesDB(clock, wal=wal)
-        self.scraper = Scraper(
-            self.db,
-            interval=self.intervals.scrape,
-            tracer=tracer,
-            selfmetrics=self.selfmetrics,
-        )
+        # Sharded plane (ISSUE 6): scrape_shards > 0 splits scraping across
+        # hash-ring shards (each with its own TSDB) and hands every consumer
+        # a FederatedTSDB merging them with the global DB.  Writes — rule
+        # outputs, staleness, SLO counters — still land in the global DB,
+        # which keeps the WAL; raw scraped series live in the shards.
+        self.shard_plane = None
+        if scrape_shards:
+            from k8s_gpu_hpa_tpu.metrics.federation import (
+                FederatedTSDB,
+                ShardedScrapePlane,
+            )
+
+            self.shard_plane = ShardedScrapePlane(
+                clock,
+                scrape_shards,
+                interval=self.intervals.scrape,
+                tracer=tracer,
+                selfmetrics=self.selfmetrics,
+            )
+            self.db = FederatedTSDB(
+                TimeSeriesDB(clock, wal=wal), self.shard_plane.shard_dbs
+            )
+            self.scraper = self.shard_plane
+        else:
+            self.db = TimeSeriesDB(clock, wal=wal)
+            self.scraper = Scraper(
+                self.db,
+                interval=self.intervals.scrape,
+                tracer=tracer,
+                selfmetrics=self.selfmetrics,
+            )
         # Structured scrapes (the default) hand the scraper pre-parsed
         # MetricFamily lists — identical samples, no text encode/parse round
         # trip per tick (tests/test_tsdb_scale.py proves equivalence).
@@ -247,10 +272,15 @@ class AutoscalingPipeline:
             return
         self._started = True
         self._periodic(self.intervals.scrape, lambda: self.scraper.scrape_once())
-        self._periodic(
-            self.intervals.rule_eval, lambda: self.evaluator.evaluate_once()
-        )
+        self._periodic(self.intervals.rule_eval, lambda: self._rule_tick())
         self._periodic(self.intervals.hpa_sync, lambda: self.hpa.sync_once())
+
+    def _rule_tick(self) -> None:
+        """One rule-eval tick: shard-local rules first (the federation
+        pre-reductions), then the global evaluator that reads them."""
+        if self.shard_plane is not None:
+            self.shard_plane.evaluate_rules_once()
+        self.evaluator.evaluate_once()
 
     def _periodic(self, interval: float, fn) -> None:
         def tick():
@@ -278,6 +308,12 @@ class AutoscalingPipeline:
         Every consumer holding a ``db`` reference is rewired, and the scraper
         staggers its next sweep so the recovered plane is not hit by the
         whole fleet on one tick."""
+        if self.shard_plane is not None:
+            raise RuntimeError(
+                "restart_tsdb drives the single-TSDB durability path; "
+                "sharded pipelines keep raw series in memory-only shard DBs "
+                "(Prometheus-agent semantics: a restarted agent re-scrapes)"
+            )
         old = self.db
         if from_wal and self.wal is not None:
             from k8s_gpu_hpa_tpu.metrics.wal import WriteAheadLog

@@ -33,8 +33,9 @@ from pathlib import Path
 
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
 from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline, PipelineIntervals
-from k8s_gpu_hpa_tpu.metrics.rules import Avg, RecordingRule, Select
+from k8s_gpu_hpa_tpu.metrics.rules import Aggregate, Avg, Ratio, RecordingRule, Select
 from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+from k8s_gpu_hpa_tpu.perfgates import UNCOMPRESSED_BYTES_PER_SAMPLE
 from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
 
 #: how many prebaked exposition variants each synthetic target cycles
@@ -86,20 +87,67 @@ def fleet_rule() -> RecordingRule:
     )
 
 
+def fleet_shard_rules(shard: int) -> list[RecordingRule]:
+    """Per-shard pre-reductions (the Prometheus federation pattern): each
+    shard records the sum and count over ITS slice of the fleet, labeled by
+    shard, so the global average never re-scans raw series."""
+    sel = Select("fleet_duty_cycle", {"job": "fleet"})
+    labels = {"job": "fleet-agg", "shard": str(shard)}
+    return [
+        RecordingRule(
+            record="fleet_duty_cycle_sum",
+            expr=Aggregate("sum", sel),
+            labels=dict(labels),
+        ),
+        RecordingRule(
+            record="fleet_duty_cycle_count",
+            expr=Aggregate("count", sel),
+            labels=dict(labels),
+        ),
+    ]
+
+
+def fleet_federated_rule() -> RecordingRule:
+    """The federated fleet average: ``sum(shard sums) / sum(shard counts)``
+    over the S pre-reduced series — O(shards) per eval instead of O(fleet).
+    Same output series as :func:`fleet_rule`, so every consumer (adapter
+    read, drill timeline) is oblivious to which plane computed it."""
+    return RecordingRule(
+        record="fleet_duty_cycle_avg",
+        expr=Ratio(
+            Aggregate("sum", Select("fleet_duty_cycle_sum", {"job": "fleet-agg"})),
+            Aggregate("sum", Select("fleet_duty_cycle_count", {"job": "fleet-agg"})),
+        ),
+        labels={"namespace": "default", "deployment": "fleet"},
+    )
+
+
 def run_fleet_scale(
     targets: int = 1000,
     horizon_s: float = 3600.0,
     scrape_interval: float = 15.0,
     rule_interval: float = 5.0,
     sample_every: float = 60.0,
+    shards: int = 0,
 ) -> dict:
     """Drive a full ``AutoscalingPipeline`` plus ``targets`` synthetic fleet
     targets for ``horizon_s`` virtual seconds; return scale metrics.
 
-    The returned dict is the ``sim_scale`` bench-rung payload: wall time,
-    virtual/wall ``speedup``, ``peak_retained_points`` (retention bound),
-    query latency percentiles, and the rule evaluator's full/skipped split.
-    """
+    The returned dict is the ``sim_scale``/``sim_scale_10k`` bench-rung
+    payload: wall time, virtual/wall ``speedup``, ``peak_retained_points``
+    (retention bound), retained-bytes accounting (``bytes_per_sample`` and
+    ``compression_ratio`` vs the 16-byte uncompressed point), query latency
+    percentiles, appends/sec, and the rule evaluator's full/skipped split.
+
+    ``shards > 0`` runs the sharded plane: targets split across hash-ring
+    scraper shards, per-shard sum/count pre-reductions, and the federated
+    ``Ratio`` fleet average.  The gated ``query_p95_ms`` then times the
+    queries the plane actually serves steady-state — per-shard fleet scans
+    (each ~targets/shards series, like-for-like with the unsharded fleet
+    scan) and the adapter's federated single-series read — while the full
+    cross-shard union scan is reported separately as
+    ``federated_scan_p95_ms`` (it exists for completeness, not on any
+    steady-state path)."""
     clock = VirtualClock()
     cluster = SimCluster(
         clock,
@@ -125,7 +173,7 @@ def run_fleet_scale(
         rule_eval=rule_interval,
         hpa_sync=15.0,
     )
-    rule = fleet_rule()
+    rule = fleet_federated_rule() if shards else fleet_rule()
     pipe = AutoscalingPipeline(
         cluster,
         dep,
@@ -133,7 +181,10 @@ def run_fleet_scale(
         max_replicas=8,
         intervals=intervals,
         extra_rules=[rule],
+        scrape_shards=shards,
     )
+    if shards:
+        pipe.shard_plane.add_shard_rules(fleet_shard_rules, interval=rule_interval)
     for i in range(targets):
         pipe.scraper.add_target(_synthetic_fetch(i), name=f"fleet/synt-{i:04d}")
 
@@ -141,7 +192,9 @@ def run_fleet_scale(
     pipe.start()
 
     query_times_ms: list[float] = []
+    fed_times_ms: list[float] = []
     peak_points = db.total_points()
+    peak_bytes = db.retained_bytes()
     # The drive loop's allocations are acyclic (tuples/lists, freed by
     # refcount); pausing the cyclic collector keeps a large host process
     # (pytest with jax loaded: millions of heap objects per gen-2 sweep)
@@ -156,30 +209,61 @@ def run_fleet_scale(
             clock.advance(step)
             elapsed += step
             peak_points = max(peak_points, db.total_points())
-            # the two query shapes the plane serves: a matcher scan over the
-            # whole fleet (index path) and the adapter's single-series read
-            # (last-point fast path)
-            q0 = time.perf_counter()
-            vec = db.instant_vector("fleet_duty_cycle", {"job": "fleet"})
-            q1 = time.perf_counter()
-            db.latest("fleet_duty_cycle_avg", {"deployment": "fleet"})
-            q2 = time.perf_counter()
-            query_times_ms.append((q1 - q0) * 1e3)
-            query_times_ms.append((q2 - q1) * 1e3)
+            peak_bytes = max(peak_bytes, db.retained_bytes())
+            if shards:
+                # the steady-state query shapes of the sharded plane: each
+                # shard's local fleet scan (what its recording rules run over,
+                # ~targets/shards series apiece) and the adapter's federated
+                # single-series read
+                for shard_db in pipe.shard_plane.shard_dbs:
+                    q0 = time.perf_counter()
+                    shard_db.instant_vector("fleet_duty_cycle", {"job": "fleet"})
+                    query_times_ms.append((time.perf_counter() - q0) * 1e3)
+                q0 = time.perf_counter()
+                db.latest("fleet_duty_cycle_avg", {"deployment": "fleet"})
+                query_times_ms.append((time.perf_counter() - q0) * 1e3)
+                # the full cross-shard union scan — not on any steady-state
+                # path (rules read pre-reductions), reported ungated
+                q0 = time.perf_counter()
+                vec = db.instant_vector("fleet_duty_cycle", {"job": "fleet"})
+                fed_times_ms.append((time.perf_counter() - q0) * 1e3)
+            else:
+                # the two query shapes the plane serves: a matcher scan over
+                # the whole fleet (index path) and the adapter's
+                # single-series read (last-point fast path)
+                q0 = time.perf_counter()
+                vec = db.instant_vector("fleet_duty_cycle", {"job": "fleet"})
+                q1 = time.perf_counter()
+                db.latest("fleet_duty_cycle_avg", {"deployment": "fleet"})
+                q2 = time.perf_counter()
+                query_times_ms.append((q1 - q0) * 1e3)
+                query_times_ms.append((q2 - q1) * 1e3)
         wall = time.perf_counter() - wall_start
     finally:
         if gc_was_enabled:
             gc.enable()
 
     query_times_ms.sort()
-    return {
+    fed_times_ms.sort()
+    total_points = db.total_points()
+    bytes_per_sample = db.retained_bytes() / total_points if total_points else 0.0
+    result = {
         "targets": targets,
         "horizon_s": horizon_s,
+        "shards": shards,
         "wall_s": round(wall, 3),
         "speedup": round(horizon_s / wall, 1) if wall > 0 else float("inf"),
         "peak_retained_points": peak_points,
-        "final_retained_points": db.total_points(),
+        "final_retained_points": total_points,
+        "peak_retained_bytes": peak_bytes,
+        "bytes_per_sample": round(bytes_per_sample, 3),
+        "compression_ratio": round(
+            UNCOMPRESSED_BYTES_PER_SAMPLE / bytes_per_sample, 2
+        )
+        if bytes_per_sample
+        else 0.0,
         "total_appends": db.total_appends(),
+        "appends_per_sec": round(db.total_appends() / wall, 0) if wall > 0 else 0.0,
         "series_count": db.series_count(),
         "fleet_vector_size": len(vec),
         "query_p50_ms": round(_percentile(query_times_ms, 0.50), 4),
@@ -189,6 +273,15 @@ def run_fleet_scale(
         "final_replicas": pipe.replicas(),
         "scale_events": len(pipe.scale_history),
     }
+    if shards:
+        status = pipe.shard_plane.shard_status()
+        fleet_names = status["fleet"]
+        synth = {f"fleet/synt-{i:04d}" for i in range(targets)}
+        owned = set(fleet_names)
+        result["federated_scan_p95_ms"] = round(_percentile(fed_times_ms, 0.95), 4)
+        result["shards_disjoint"] = len(fleet_names) == len(owned)
+        result["shards_cover_fleet"] = synth <= owned
+    return result
 
 
 # ---- recovery drill (ISSUE 4: durability under crash/restart) ---------------

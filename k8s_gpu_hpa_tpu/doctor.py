@@ -159,6 +159,52 @@ def check_scrape_up(payload: str) -> str:
     return f"all {len(results)} scrape targets up"
 
 
+def check_shards(payload: str) -> str:
+    """L3 shard topology (sharded scrape planes only): every scraper shard
+    reachable, shard target sets pairwise disjoint, and their union covering
+    the whole fleet.  A shard that is down silently halves nothing — its
+    targets just stop being scraped while the federated average keeps being
+    served from survivors — and an assignment bug (two shards claiming one
+    target, or none claiming it) double-counts or drops series the global
+    rules read.  ``payload`` is ``ShardedScrapePlane.shard_status_json()``
+    (in production: each agent's /-/ready plus its target list)."""
+    doc = json.loads(payload)
+    shards = doc.get("shards", [])
+    if not shards:
+        raise AssertionError("no shards reported: not a sharded scrape plane?")
+    unreachable = [s["shard"] for s in shards if not s.get("reachable", False)]
+    if unreachable:
+        raise AssertionError(
+            f"shard(s) {unreachable} unreachable: their targets are not "
+            "being scraped (the federated aggregate keeps serving from "
+            "survivors, so this degrades coverage silently)"
+        )
+    owned: dict[str, int] = {}
+    dupes = []
+    for s in shards:
+        for name in s["targets"]:
+            if name in owned:
+                dupes.append(f"{name} (shards {owned[name]} and {s['shard']})")
+            owned[name] = s["shard"]
+    if dupes:
+        raise AssertionError(
+            f"{len(dupes)} target(s) owned by more than one shard — "
+            "double-scraped and double-counted by fleet aggregates: "
+            + ", ".join(sorted(dupes)[:5])
+        )
+    fleet = doc.get("fleet", [])
+    orphans = sorted(set(fleet) - set(owned))
+    if orphans:
+        raise AssertionError(
+            f"{len(orphans)} fleet target(s) owned by no shard (never "
+            "scraped): " + ", ".join(orphans[:5])
+        )
+    return (
+        f"{len(shards)} shards reachable, {len(owned)} targets "
+        "disjointly owned, union covers fleet"
+    )
+
+
 def check_self_metrics(payload: str) -> str:
     """Pipeline self-observation: every self-metric family present and fresh
     (mirror of :func:`check_scrape_up` for the ``pipeline-self`` target).
@@ -350,6 +396,7 @@ def diagnose(
     up_fetch: Callable[[], str] | None = None,
     self_metrics_fetch: Callable[[], str] | None = None,
     self_exposition_fetch: Callable[[], str] | None = None,
+    shards_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -371,6 +418,11 @@ def diagnose(
             "L3 scrape health",
             "every scrape target serving (up==1)",
             (lambda: check_scrape_up(up_fetch())) if up_fetch else None,
+        ),
+        (
+            "L3 shard topology",
+            "every scraper shard reachable, target sets disjoint, union covers fleet",
+            (lambda: check_shards(shards_fetch())) if shards_fetch else None,
         ),
         (
             "L3 self-metrics",

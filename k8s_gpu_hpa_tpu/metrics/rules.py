@@ -191,6 +191,32 @@ class Aggregate(Expr):
 
 
 @dataclass
+class Ratio(Expr):
+    """``left / right`` over two scalar-producing expressions — the
+    federation-aggregate idiom: a global average computed as
+    ``sum(per_shard_sums) / sum(per_shard_counts)`` instead of re-scanning
+    every raw series the shards already reduced.  Empty operands or a zero
+    denominator yield an empty vector (the output series goes stale rather
+    than recording a division artifact)."""
+
+    left: Expr
+    right: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        num = self.left.evaluate(db, at)
+        den = self.right.evaluate(db, at)
+        if not num or not den or den[0].value == 0.0:
+            return []
+        return [Sample(num[0].value / den[0].value, ())]
+
+    def input_names(self) -> frozenset[str]:
+        return self.left.input_names() | self.right.input_names()
+
+    def promql(self) -> str:
+        return f"({self.left.promql()}) / ({self.right.promql()})"
+
+
+@dataclass
 class AndOn(Expr):
     """``left and on() right`` — PromQL set intersection with an empty match
     group: left's samples survive iff right is non-empty.  The gate idiom —

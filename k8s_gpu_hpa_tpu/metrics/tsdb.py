@@ -33,70 +33,208 @@ returns, a snapshot is cut every ``snapshot_every`` logged records, and
 ``TimeSeriesDB.recover(wal)`` rebuilds the full store — series, inverted
 index, version counters, pending-staleness map, point origins — from the
 snapshot plus a tail replay that tolerates a torn final record.
+
+Columnar storage (ISSUE 6): each series is a run of sealed immutable
+:class:`~k8s_gpu_hpa_tpu.metrics.gorilla.GorillaChunk` columns plus a small
+*compressed* mutable head — appends encode straight into the head's
+delta-of-delta/XOR streams (metrics/gorilla.py), so even the live window is
+~4-8x smaller than the old tuple lists.  The head seals into a chunk every
+``chunk_size`` points (an O(1) freeze of the byte buffers); retention drops
+whole aged-out chunks from the front.  Cached last-point scalars keep the
+``at >= newest`` read O(1) with no decode; historical reads decode one chunk
+into numpy arrays (bounded cache) and ``searchsorted``.  Snapshots carry the
+compressed blobs verbatim (format 2); format-1 snapshots from the
+pre-columnar engine still replay, re-encoded point by point.
 """
 
 from __future__ import annotations
 
+import base64
 import math
 import random
 import time
 import zlib
-from bisect import bisect_left, bisect_right
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
 from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
+from k8s_gpu_hpa_tpu.metrics.gorilla import (
+    GorillaChunk,
+    GorillaEncoder,
+    decode_ts,
+    decode as gorilla_decode,
+)
 from k8s_gpu_hpa_tpu.metrics.schema import Exemplar, MetricFamily, Sample
 from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 
 LabelSet = tuple[tuple[str, str], ...]
 
+#: WAL snapshot payload format written by ``TimeSeriesDB.snapshot``.
+#: 1 = pre-columnar (per-point JSON triples); 2 = Gorilla chunk blobs.
+#: ``recover`` negotiates: a payload without a ``format`` field is v1.
+SNAPSHOT_FORMAT = 2
+
 
 class _Series:
-    """One labeled series: parallel (ts, points) lists, sorted by construction
-    (``TimeSeriesDB.append`` rejects time travel), so reads bisect.
+    """One labeled series: sealed Gorilla chunks + a compressed head.
 
-    Retention is enforced on append (inlined in ``TimeSeriesDB.append``, the
-    hottest path at fleet scale): once the dead prefix (points older than
-    ``newest - retention``) outgrows the live suffix it is dropped in one
-    slice — amortized O(1) per append, and the retained list never exceeds
-    ~2x the window.  A staleness marker can only be dropped together with
-    every point BEFORE it (the trim removes a strict prefix), so trimming can
-    never resurrect an ended series: a historical read that would have hit
-    the marker now finds nothing at all, which reads the same (None).
+    Points live in two places, both sorted by construction
+    (``TimeSeriesDB.append`` rejects time travel):
+
+    - ``chunks``: immutable :class:`GorillaChunk` runs of ``chunk_size``
+      points, decoded lazily (and cached, bounded by the owning DB) for
+      historical reads;
+    - the head: a streaming :class:`GorillaEncoder` the hot append path
+      writes into directly.  Keeping the head compressed matters — at a
+      15 s scrape cadence a 300 s window holds ~20 points/series, *fewer*
+      than one chunk, so an uncompressed head would dominate retained
+      bytes and erase the whole compression win.
+
+    ``last_ts``/``last_val``/``last_origin`` mirror the newest point so the
+    dominant ``at >= newest`` read never touches the encoder.  Retention
+    drops whole chunks from the front once their ``last_ts`` ages out; a
+    staleness marker can only be dropped together with every point BEFORE
+    it (chunk drops are strict prefixes), so trimming can never resurrect
+    an ended series: a historical read that would have hit the marker now
+    finds nothing at all, which reads the same (None).
     """
 
-    __slots__ = ("labels", "points", "ts")
+    __slots__ = ("labels", "chunks", "enc", "head_origins", "head_first_ts",
+                 "last_ts", "last_val", "last_origin", "_head_cache")
 
     def __init__(self, labels: LabelSet):
         self.labels = labels
-        #: (ts, value, origin) — origin is the span id of the pipeline stage
-        #: that wrote the point (obs/trace.py), or None when untraced
-        self.points: list[tuple[float, float, int | None]] = []
-        #: parallel timestamp list, the bisect key (kept separate so the
-        #: search never allocates point tuples)
-        self.ts: list[float] = []
+        self.chunks: list[GorillaChunk] = []
+        self.enc = GorillaEncoder()
+        #: origin span ids parallel to the head stream (obs/trace.py), or
+        #: None while every head point is untraced (the common case)
+        self.head_origins: list[int | None] | None = None
+        self.head_first_ts = 0.0
+        self.last_ts = -math.inf
+        self.last_val = math.nan
+        self.last_origin: int | None = None
+        #: memoized head decode, invalidated by count (appends bump it)
+        self._head_cache: tuple | None = None
+
+    def push(self, ts: float, value: float, origin: int | None) -> None:
+        """Store one point (restore paths; ``TimeSeriesDB.append`` inlines
+        this same sequence on the hot path).  Caller seals/trims."""
+        enc = self.enc
+        if enc.count == 0:
+            self.head_first_ts = ts
+        enc.append(ts, value)
+        origins = self.head_origins
+        if origin is not None:
+            if origins is None:
+                origins = self.head_origins = [None] * (enc.count - 1)
+            origins.append(origin)
+        elif origins is not None:
+            origins.append(None)
+        self._head_cache = None
+        self.last_ts = ts
+        self.last_val = value
+        self.last_origin = origin
+
+    def seal_head(self) -> None:
+        """Freeze the head streams into an immutable chunk — O(1) in the
+        point count (the byte buffers are copied, never re-encoded)."""
+        enc = self.enc
+        origins = self.head_origins
+        self.chunks.append(
+            GorillaChunk(
+                enc.count,
+                bytes(enc.ts_buf),
+                bytes(enc.val_buf),
+                self.head_first_ts,
+                self.last_ts,
+                None if origins is None else tuple(origins),
+                enc.ts_mode,
+            )
+        )
+        enc.reset()
+        self.head_origins = None
+        self._head_cache = None
+
+    def head_arrays(self):
+        """Decoded (ts, values) arrays of the head stream, memoized until
+        the next append."""
+        enc = self.enc
+        cache = self._head_cache
+        if cache is not None and cache[0] == enc.count:
+            return cache[1], cache[2]
+        ts_arr, val_arr = gorilla_decode(
+            bytes(enc.ts_buf), bytes(enc.val_buf), enc.count, enc.ts_mode
+        )
+        self._head_cache = (enc.count, ts_arr, val_arr)
+        return ts_arr, val_arr
+
+    def npoints(self) -> int:
+        return self.enc.count + sum(c.count for c in self.chunks)
+
+    def nbytes(self) -> int:
+        """Retained compressed bytes (blobs + 8 per tracked origin)."""
+        enc = self.enc
+        n = len(enc.ts_buf) + len(enc.val_buf)
+        if self.head_origins is not None:
+            n += 8 * len(self.head_origins)
+        for chunk in self.chunks:
+            n += chunk.nbytes()
+        return n
+
+    def _locate(self, at: float, chunk_arrays=None):
+        """Newest (ts, value, origin) at/before ``at`` — no staleness or
+        lookback policy (callers apply it).  ``chunk_arrays`` is the owning
+        DB's cached decoder; defaults to uncached decode."""
+        enc = self.enc
+        if enc.count and at >= self.head_first_ts:
+            ts_arr, val_arr = self.head_arrays()
+            idx = int(ts_arr.searchsorted(at, side="right")) - 1
+            if idx >= 0:
+                origins = self.head_origins
+                return (
+                    float(ts_arr[idx]),
+                    float(val_arr[idx]),
+                    None if origins is None else origins[idx],
+                )
+        for chunk in reversed(self.chunks):
+            if chunk.first_ts <= at:
+                if chunk_arrays is None:
+                    ts_arr, val_arr = chunk.arrays()
+                else:
+                    ts_arr, val_arr = chunk_arrays(chunk)
+                idx = int(ts_arr.searchsorted(at, side="right")) - 1
+                if idx < 0:
+                    return None
+                origins = chunk.origins
+                return (
+                    float(ts_arr[idx]),
+                    float(val_arr[idx]),
+                    None if origins is None else origins[idx],
+                )
+        return None
 
     def latest_point_at(
-        self, at: float, lookback: float
+        self, at: float, lookback: float, chunk_arrays=None
     ) -> tuple[float, float, int | None] | None:
-        tslist = self.ts
-        if not tslist:
-            return None
         # Fast path: the common ``at=now`` read lands at/after the newest
-        # point; historical reads (lineage replay, chaos reports) bisect.
-        if at >= tslist[-1]:
-            idx = len(tslist) - 1
-        else:
-            idx = bisect_right(tslist, at) - 1
-            if idx < 0:
+        # point, served from the cached scalars with no decode at all.
+        last_ts = self.last_ts
+        if at >= last_ts:
+            if last_ts == -math.inf:
                 return None
-        point = self.points[idx]
+            value = self.last_val
+            # A NaN point is a staleness marker (Prometheus semantics:
+            # written when a scrape fails or a rule's output series
+            # disappears) and ends the series immediately.  value != value
+            # is the allocation-free math.isnan.
+            if value != value or at - last_ts > lookback:
+                return None
+            return (last_ts, value, self.last_origin)
+        point = self._locate(at, chunk_arrays)
+        if point is None:
+            return None
         value = point[1]
-        # A NaN point is a staleness marker (Prometheus semantics: written
-        # when a scrape fails or a rule's output series disappears) and ends
-        # the series immediately.  value != value is the allocation-free
-        # math.isnan.
         if value != value or at - point[0] > lookback:
             return None
         return point
@@ -104,6 +242,37 @@ class _Series:
     def latest_at(self, at: float, lookback: float) -> float | None:
         point = self.latest_point_at(at, lookback)
         return None if point is None else point[1]
+
+    # -- decoded views (tests, tooling; not on any hot path) -----------------
+
+    @property
+    def points(self) -> list[tuple[float, float, int | None]]:
+        """All retained (ts, value, origin) tuples, decoded — the same view
+        the pre-columnar engine stored directly."""
+        out: list[tuple[float, float, int | None]] = []
+        for chunk in self.chunks:
+            ts_arr, val_arr = chunk.arrays()
+            origins = chunk.origins
+            if origins is None:
+                origins = (None,) * chunk.count
+            out.extend(zip(ts_arr.tolist(), val_arr.tolist(), origins))
+        if self.enc.count:
+            ts_arr, val_arr = self.head_arrays()
+            origins = self.head_origins
+            if origins is None:
+                origins = (None,) * self.enc.count
+            out.extend(zip(ts_arr.tolist(), val_arr.tolist(), origins))
+        return out
+
+    @property
+    def ts(self) -> list[float]:
+        """All retained timestamps, decoded."""
+        out: list[float] = []
+        for chunk in self.chunks:
+            out.extend(decode_ts(chunk.ts_blob, chunk.count, chunk.ts_mode).tolist())
+        if self.enc.count:
+            out.extend(self.head_arrays()[0].tolist())
+        return out
 
 
 class TimeSeriesDB:
@@ -113,6 +282,10 @@ class TimeSeriesDB:
     #: staleness marker has aged out of the lookback window
     GC_EVERY = 4096
 
+    #: chunks allowed to hold a decoded numpy cache at once (historical
+    #: reads cluster on recent chunks; the blobs themselves always stay)
+    DECODE_CACHE_CHUNKS = 32
+
     def __init__(
         self,
         clock: Clock | None = None,
@@ -120,6 +293,7 @@ class TimeSeriesDB:
         retention: float | None = None,
         wal=None,
         snapshot_every: int = 8192,
+        chunk_size: int = 64,
     ):
         self.clock = clock or SystemClock()
         self.lookback = lookback
@@ -146,6 +320,13 @@ class TimeSeriesDB:
         #: metrics→traces bridge: a histogram bucket's newest traced
         #: observation).  Persisted through WAL records and snapshots.
         self._exemplars: dict[tuple[str, LabelSet], Exemplar] = {}
+        #: seal the compressed head into an immutable chunk every this-many
+        #: points per series (Prometheus defaults to 120; 64 keeps retention
+        #: granularity fine enough for the 300 s default window)
+        self.chunk_size = chunk_size
+        #: chunks currently holding a decoded cache, eviction order (each
+        #: chunk appears at most once: it joins on decode, leaves on evict)
+        self._decoded_chunks: deque[GorillaChunk] = deque()
         self._total_points = 0
         self._appends_since_gc = 0
         #: active read-capture sink (see begin_capture), else None
@@ -182,29 +363,43 @@ class TimeSeriesDB:
             index = self._index.setdefault(name, {})
             for pair in labels:
                 index.setdefault(pair, {})[labels] = None
-        elif series.ts and ts < series.ts[-1]:
-            # Out-of-order appends would silently break the bisect/scan-from-
-            # end invariant every read relies on; reject loudly.  Equal
-            # timestamps are allowed (a re-write within one tick wins).
+        elif ts < series.last_ts:
+            # Out-of-order appends would silently break the sorted-columns/
+            # scan-from-end invariant every read relies on; reject loudly.
+            # Equal timestamps are allowed (a re-write within one tick wins).
             raise ValueError(
                 f"out-of-order append to {name}{dict(series.labels)}: "
-                f"ts {ts} < newest {series.ts[-1]}"
+                f"ts {ts} < newest {series.last_ts}"
             )
-        # Inlined _Series.append_point (this is the hottest statement in a
-        # fleet-scale run; the call overhead alone was measurable): append,
-        # then trim the aged-out prefix once it dominates the list —
-        # amortized O(1), retained length bounded by ~2x the window, and a
-        # strict prefix drop can never resurrect a marker-ended series.
-        series.points.append((ts, value, origin))
-        tslist = series.ts
-        tslist.append(ts)
+        # Inlined _Series.push (this is the hottest statement in a
+        # fleet-scale run; the call overhead alone was measurable): encode
+        # into the compressed head, mirror the last-point scalars, seal a
+        # full head into a chunk, then drop whole aged-out chunks from the
+        # front — amortized O(1), and a strict prefix drop can never
+        # resurrect a marker-ended series.
+        enc = series.enc
+        if enc.count == 0:
+            series.head_first_ts = ts
+        enc.append(ts, value)
+        origins = series.head_origins
+        if origin is not None:
+            if origins is None:
+                origins = series.head_origins = [None] * (enc.count - 1)
+            origins.append(origin)
+        elif origins is not None:
+            origins.append(None)
+        series._head_cache = None
+        series.last_ts = ts
+        series.last_val = value
+        series.last_origin = origin
+        if enc.count >= self.chunk_size:
+            series.seal_head()
         dropped = 0
-        if tslist[0] < ts - self.retention:
-            idx = bisect_left(tslist, ts - self.retention)
-            if 2 * idx >= len(tslist):
-                del series.points[:idx]
-                del tslist[:idx]
-                dropped = idx
+        chunks = series.chunks
+        if chunks:
+            cutoff = ts - self.retention
+            while chunks and chunks[0].last_ts < cutoff:
+                dropped += chunks.pop(0).count
         self._total_points += 1 - dropped
         self._versions[name] = self._versions.get(name, 0) + 1
         if value != value:  # NaN marker: schedule the ended series for GC
@@ -244,7 +439,7 @@ class TimeSeriesDB:
             series = by_name.pop(labels, None) if by_name is not None else None
             if series is None:
                 continue
-            self._total_points -= len(series.points)
+            self._total_points -= series.npoints()
             index = self._index.get(name)
             if index is not None:
                 for pair in labels:
@@ -269,24 +464,47 @@ class TimeSeriesDB:
         restart boundary), the per-name version counters (so incremental rule
         eval's dirty-bit comparisons stay semantically exact), and the
         pending-staleness map (so marker GC resumes where it left off).
-        NaN points (staleness markers) are encoded as ``null`` values —
-        the snapshot never relies on JSON's non-standard NaN literal."""
+
+        Format 2: the compressed columns travel verbatim — sealed chunks and
+        the head stream are base64 blobs, so NaN markers, ±inf, and every
+        bit of every float round-trip exactly (no JSON float re-encoding,
+        and no reliance on JSON's non-standard NaN literal)."""
         if self.wal is None:
             return
+        b64 = base64.b64encode
         series_out = []
         for name, by_name in self._data.items():
             for series in by_name.values():
+                enc = series.enc
                 series_out.append(
                     {
                         "name": name,
                         "labels": list(series.labels),
-                        "points": [
-                            [ts, None if v != v else v, origin]
-                            for ts, v, origin in series.points
+                        "chunks": [
+                            [
+                                c.count,
+                                b64(c.ts_blob).decode("ascii"),
+                                b64(c.val_blob).decode("ascii"),
+                                None if c.origins is None else list(c.origins),
+                                c.first_ts,
+                                c.last_ts,
+                                c.ts_mode,
+                            ]
+                            for c in series.chunks
+                        ],
+                        "head": [
+                            enc.count,
+                            b64(bytes(enc.ts_buf)).decode("ascii"),
+                            b64(bytes(enc.val_buf)).decode("ascii"),
+                            None
+                            if series.head_origins is None
+                            else list(series.head_origins),
+                            enc.ts_mode,
                         ],
                     }
                 )
         payload = {
+            "format": SNAPSHOT_FORMAT,
             "at": self.clock.now(),
             "lookback": self.lookback,
             "retention": self.retention,
@@ -312,6 +530,7 @@ class TimeSeriesDB:
         lookback: float = 300.0,
         retention: float | None = None,
         snapshot_every: int = 8192,
+        chunk_size: int = 64,
     ) -> "TimeSeriesDB":
         """Rebuild a TSDB from its durable state: restore the snapshot, then
         replay the WAL tail in append order.  Replay goes through ``append``
@@ -322,6 +541,12 @@ class TimeSeriesDB:
         that still lands out of order (e.g. after a ``wal_truncate`` tear) is
         dropped, never fatal — recovery must always produce a serving DB.
 
+        Snapshot format negotiation: a format-2 payload installs the Gorilla
+        blobs verbatim (chunks byte-identical, the head encoder resumed
+        mid-stream); a payload without a ``format`` field is a v1
+        (pre-columnar) snapshot whose per-point triples re-encode through
+        the columnar path — old WALs replay into the new engine unchanged.
+
         The recovered instance takes ownership of ``wal`` and stamps
         ``last_recovery`` with replay stats (the chaos RecoveryReports read
         ``replay gap`` = recovery wall position minus newest replayed ts)."""
@@ -331,28 +556,75 @@ class TimeSeriesDB:
             lookback=(payload or {}).get("lookback", lookback),
             retention=(payload or {}).get("retention", retention),
             snapshot_every=snapshot_every,
+            chunk_size=chunk_size,
         )
         newest_ts = -math.inf
         recovered_points = 0
         if payload is not None:
+            fmt = payload.get("format", 1)
+            b64 = base64.b64decode
             for entry in payload["series"]:
                 name = entry["name"]
                 labels = tuple((k, v) for k, v in entry["labels"])
                 labels = db._intern.setdefault(labels, labels)
                 series = _Series(labels)
-                for ts, value, origin in entry["points"]:
-                    value = float("nan") if value is None else value
-                    series.points.append((ts, value, origin))
-                    series.ts.append(ts)
-                if not series.ts:
-                    continue
+                if fmt >= 2:
+                    for count, tsb, vb, origins, first_ts, last_ts, mode in entry[
+                        "chunks"
+                    ]:
+                        series.chunks.append(
+                            GorillaChunk(
+                                count,
+                                b64(tsb),
+                                b64(vb),
+                                first_ts,
+                                last_ts,
+                                None if origins is None else tuple(origins),
+                                mode,
+                            )
+                        )
+                    hcount, htsb, hvb, horigins, hmode = entry["head"]
+                    if hcount:
+                        series.enc.restore(b64(htsb), b64(hvb), hcount, hmode)
+                        series.head_origins = (
+                            None if horigins is None else list(horigins)
+                        )
+                        ts_arr, val_arr = series.head_arrays()
+                        series.head_first_ts = float(ts_arr[0])
+                        series.last_ts = float(ts_arr[-1])
+                        series.last_val = float(val_arr[-1])
+                        series.last_origin = (
+                            None
+                            if series.head_origins is None
+                            else series.head_origins[-1]
+                        )
+                    elif series.chunks:
+                        last = series.chunks[-1]
+                        ts_arr, val_arr = db._chunk_arrays(last)
+                        series.last_ts = float(ts_arr[-1])
+                        series.last_val = float(val_arr[-1])
+                        series.last_origin = (
+                            None if last.origins is None else last.origins[-1]
+                        )
+                else:
+                    # v1: per-point triples (NaN as null), re-encoded through
+                    # the same storage path that builds live series
+                    for ts, value, origin in entry["points"]:
+                        series.push(
+                            ts, float("nan") if value is None else value, origin
+                        )
+                        if series.enc.count >= db.chunk_size:
+                            series.seal_head()
+                if series.last_ts == -math.inf:
+                    continue  # empty series: nothing to install
                 db._data.setdefault(name, {})[labels] = series
                 index = db._index.setdefault(name, {})
                 for pair in labels:
                     index.setdefault(pair, {})[labels] = None
-                db._total_points += len(series.points)
-                recovered_points += len(series.points)
-                newest_ts = max(newest_ts, series.ts[-1])
+                npts = series.npoints()
+                db._total_points += npts
+                recovered_points += npts
+                newest_ts = max(newest_ts, series.last_ts)
             db._versions.update(payload.get("versions", {}))
             for name, labels, ts in payload.get("stale_pending", []):
                 labels = tuple((k, v) for k, v in labels)
@@ -467,29 +739,45 @@ class TimeSeriesDB:
             series_list = by_name.values()
         lookback = self.lookback
         capture = self._capture
+        chunk_arrays = self._chunk_arrays
         out: list[Sample] = []
         for series in series_list:
             # Inlined _Series.latest_point_at (a fleet-wide matcher query
             # walks ~1000 series; the per-series call was the loop's cost):
-            # at >= newest is the fast path, history bisects, NaN (staleness
-            # marker, value != value) and lookback-expired points end it.
-            tslist = series.ts
-            if not tslist:
-                continue
-            if at >= tslist[-1]:
-                idx = len(tslist) - 1
-            else:
-                idx = bisect_right(tslist, at) - 1
-                if idx < 0:
+            # at >= newest reads the cached last-point scalars — zero decode
+            # — history searchsorts decoded columns, NaN (staleness marker,
+            # value != value) and lookback-expired points end it.
+            pt_ts = series.last_ts
+            if at >= pt_ts:
+                value = series.last_val
+                if value != value or at - pt_ts > lookback:
                     continue
-            point = series.points[idx]
-            value = point[1]
-            if value != value or at - point[0] > lookback:
-                continue
+                origin = series.last_origin
+            else:
+                point = series._locate(at, chunk_arrays)
+                if point is None:
+                    continue
+                pt_ts, value, origin = point
+                if value != value or at - pt_ts > lookback:
+                    continue
             if capture is not None:
-                capture.append((name, series.labels, point[0], value, point[2]))
+                capture.append((name, series.labels, pt_ts, value, origin))
             out.append(Sample(value, series.labels))
         return out
+
+    def _chunk_arrays(self, chunk: GorillaChunk):
+        """Decoded (ts, values) arrays of a sealed chunk, cached on the
+        chunk itself; at most ``DECODE_CACHE_CHUNKS`` caches stay live (a
+        chunk joins the eviction queue on decode and leaves on evict, so
+        membership is unique by construction)."""
+        arrs = chunk._decoded
+        if arrs is None:
+            arrs = chunk._decoded = chunk.arrays()
+            cache = self._decoded_chunks
+            cache.append(chunk)
+            if len(cache) > self.DECODE_CACHE_CHUNKS:
+                cache.popleft()._decoded = None
+        return arrs
 
     def latest(self, name: str, matchers: dict[str, str] | None = None) -> float | None:
         """Scalar convenience: value of the single matching series, else None."""
@@ -534,6 +822,19 @@ class TimeSeriesDB:
         """Points currently retained across all series — the bench's memory
         proxy (bounded retention keeps this flat over any horizon)."""
         return self._total_points
+
+    def retained_bytes(self) -> int:
+        """Compressed sample-storage bytes currently retained: Gorilla blob
+        lengths plus 8 per tracked origin span id.  Excludes per-series
+        fixed overhead (labels, index entries — identical under any point
+        representation); divide by ``total_points()`` for the bytes/sample
+        the ``sim_scale_10k`` rung gates against the 16-byte uncompressed
+        (ts, value) baseline."""
+        total = 0
+        for by_name in self._data.values():
+            for series in by_name.values():
+                total += series.nbytes()
+        return total
 
     def total_appends(self) -> int:
         """Lifetime appends across all names (trim/GC never subtract)."""

@@ -11,12 +11,21 @@ TSDB the same two artifacts, sized for the harness:
   NaN never has to round-trip through JSON).  Every record is flushed as
   written, so a kill can tear at most the final line of the final segment.
 - **snapshot** (``snapshot.json``): the DB's full retained state (series
-  points with origins, rule version counters, pending-staleness map) plus
+  storage with origins, rule version counters, pending-staleness map) plus
   ``covered_through``, the index of the newest segment whose records the
   snapshot subsumes.  Written atomically (tmp + ``os.replace``); segments
   at or below ``covered_through`` are deleted only *after* the replace
   lands, so a crash at any byte leaves either the old snapshot + all
   segments or the new snapshot + the uncovered tail — both replayable.
+
+The snapshot payload is **format-versioned** by the TSDB (its ``format``
+field, ``tsdb.SNAPSHOT_FORMAT``): format 2 carries the columnar Gorilla
+chunks as base64 blobs (bit-exact, no JSON float re-encoding); a payload
+with no ``format`` field is a format-1 (pre-columnar, per-point triples)
+snapshot and replays through the columnar append path.  This store is
+deliberately format-agnostic — it round-trips whatever dict the TSDB
+hands it, so version negotiation lives in one place
+(``TimeSeriesDB.recover``).
 
 Recovery (``TimeSeriesDB.recover``) = restore the snapshot payload, then
 replay the tail segments in order.  An undecodable line is tolerated only

@@ -13,6 +13,14 @@ t=73–74, scraped at t=74, recorded by *this* rule at t=74, served to the
 adapter and acted on by the sync at t=75.  ``complete`` is True when the
 walk reaches raw exporter samples — the acceptance bar every simulated
 scale event must meet (tests/test_obs.py).
+
+The walk is transitive, so multi-level rule chains need no special
+handling: on a sharded plane (metrics/federation.py) a scale event's
+chain passes through TWO rule_eval hops — the global federated rule read
+shard-recorded points whose origins are shard rule_eval spans, which in
+turn link to the shard's scrapes.  Both levels land in the single
+``rule_eval`` hop group (hops group by span kind, not by depth), and
+completeness still means "reached raw exporter samples".
 """
 
 from __future__ import annotations

@@ -1,0 +1,77 @@
+"""Shared performance-gate constants for the fleet-scale metrics plane.
+
+Single source of truth for every threshold the scale rungs assert —
+``tools/profile_sim.py`` (the tier-1 smoke), ``bench.py``'s ``sim_scale``
+and ``sim_scale_10k`` rungs, and the tests that pin the contract — so a
+deliberate re-baselining is ONE edit here, not a hunt through shell
+scripts and rung bodies for magic numbers that have drifted apart.
+
+Two kinds of constants live here:
+
+- **sizing** (targets / horizon / shards): what a rung runs, in full and
+  smoke flavors.  Smoke flavors exercise the same code paths at ~10-20x
+  less work so tier-1 stays fast.
+- **gates** (floors / ceilings): what a run must clear.  Floors are set
+  ~4-5x below measured dev-box numbers (see BASELINE.md) so they catch
+  algorithmic regressions — a hot path going quadratic, retention
+  stopping, compression silently falling back to raw — without flaking
+  on machine variance.
+"""
+
+from __future__ import annotations
+
+#: the uncompressed cost of one retained point — a (float64 ts, float64
+#: value) pair, what the pre-columnar tuple storage held per sample before
+#: any Python object overhead.  ``compression_ratio`` is measured against
+#: this, making the ≥4x gate a statement about the encoded columns, not
+#: about CPython boxing.
+UNCOMPRESSED_BYTES_PER_SAMPLE = 16.0
+
+# ---- sim_scale: the 1000-target unsharded rung (ISSUE 3) --------------------
+
+SIM_SCALE_TARGETS = 1000
+SIM_SCALE_HORIZON_S = 3600.0
+#: virtual-seconds-per-wall-second floor for the full rung (measured ~1300)
+SIM_SCALE_MIN_SPEEDUP = 1000.0
+
+SIM_SCALE_SMOKE_TARGETS = 200
+SIM_SCALE_SMOKE_HORIZON_S = 600.0
+SIM_SCALE_SMOKE_MIN_SPEEDUP = 100.0
+
+# ---- tools/profile_sim.py tier-1 smoke (100 targets x 10 min) ---------------
+
+PROFILE_SMOKE_TARGETS = 100
+PROFILE_SMOKE_HORIZON_S = 600.0
+#: measured ~6000 on a dev box; 20 catches "wall time exploded"
+PROFILE_SMOKE_MIN_SPEEDUP = 20.0
+#: retention bound: ~100 fleet series x ~(window + chunk slack) points plus
+#: the pipeline's own series; measured peak ~14.8k under chunked retention
+#: (whole sealed chunks drop at once, so the peak sits above the exact
+#: window size by up to chunk_size-1 points per series)
+PROFILE_SMOKE_MAX_POINTS = 25000
+
+# ---- sim_scale_10k: the sharded federation rung (ISSUE 6) -------------------
+
+SIM_SCALE_10K_TARGETS = 10000
+SIM_SCALE_10K_HORIZON_S = 3600.0
+SIM_SCALE_10K_SHARDS = 8
+#: measured ~100 on a dev box (10k targets is ~10x sim_scale's work)
+SIM_SCALE_10K_MIN_SPEEDUP = 25.0
+
+SIM_SCALE_10K_SMOKE_TARGETS = 2000
+SIM_SCALE_10K_SMOKE_HORIZON_S = 600.0
+SIM_SCALE_10K_SMOKE_SHARDS = 4
+#: measured ~550 on a dev box
+SIM_SCALE_10K_SMOKE_MIN_SPEEDUP = 50.0
+
+#: Gorilla columns must stay >= 4x denser than the 16-byte uncompressed
+#: point (measured 4.7-5.2x on the synthetic fleet; a silent fall-back to
+#: raw encoding or an origins-column leak lands well under 4)
+MIN_COMPRESSION_RATIO = 4.0
+#: gated fleet-query p95: per-shard scans (~targets/shards series each)
+#: plus the adapter's federated single-series read.  Budget is 2x the
+#: r03 unsharded 1000-series baseline of 1.5 ms (measured ~1.9 ms at 10k)
+MAX_FLEET_QUERY_P95_MS = 3.0
+#: ingest floor across the whole plane (measured ~140-190k/s; dropping
+#: below 25k/s means the append hot path gained per-point overhead)
+MIN_APPENDS_PER_SEC = 25000.0

@@ -92,11 +92,17 @@ def run_scenario(
     sample_every: float = 5.0,
     saturated_pct: float | None = None,
     trace: bool = False,
+    shards: int = 0,
 ) -> SimReport:
     """Simulate one shipped Object-metric HPA manifest under a load scenario.
 
     Behavior, bounds, target, and slice quantum all come from the manifest —
     the same parsing path the tests and bench use (the manifest IS the spec).
+
+    ``shards > 0`` runs the sharded scrape plane (metrics/federation.py):
+    targets split across hash-ring scraper shards federated into the global
+    view — every scenario (including the outage's exporter blackout and the
+    trace contract's lineage walk) must behave identically either way.
 
     ``saturated_pct`` caps the per-pod signal at the workload's MEASURED
     ceiling (e.g. `tools/serve_sizing.py` output).  The default (no cap)
@@ -163,6 +169,7 @@ def run_scenario(
         replica_quantum=quantum,
         object_kind=ref["kind"],
         tracer=tracer,
+        scrape_shards=shards,
     )
     pipe.start()
 
@@ -572,6 +579,7 @@ def main(args) -> int:
             duration=args.duration,
             pod_start_latency=args.pod_start,
             trace=True,
+            shards=getattr(args, "shards", 0),
         )
         print(render_trace_timeline(report))
         tracer = report.tracer
@@ -637,6 +645,7 @@ def main(args) -> int:
                 duration=args.duration,
                 pod_start_latency=args.pod_start,
                 saturated_pct=getattr(args, "saturated_pct", None),
+                shards=getattr(args, "shards", 0),
             )
     except ValueError as e:
         # e.g. an External manifest with an Object-only scenario (outage,
@@ -679,6 +688,13 @@ if __name__ == "__main__":
     parser.add_argument("--duration", type=float, default=420.0)
     parser.add_argument("--pod-start", type=float, default=12.0)
     parser.add_argument("--saturated-pct", type=float, default=None)
+    parser.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="run the scenario against a sharded scrape plane with N "
+        "hash-ring scraper shards (0 = single scraper)",
+    )
     parser.add_argument(
         "--trace-out",
         default="trace.jsonl",

@@ -233,3 +233,22 @@ def test_supervised_gen_late_return_does_not_mask_second_wedge():
         sup.stop()
         for g in gens:
             g._wedge.set()
+
+def test_sim_scale_10k_rung_gates_compression_query_and_ring(monkeypatch):
+    """The sharded federation rung (ISSUE 6), exercised at smoke sizing
+    (TIME_SCALE != 1 path: 2000 targets / 4 shards) so tier-1 stays fast —
+    same code paths, same gate keys as the published 10k run."""
+    monkeypatch.setattr(bench, "TIME_SCALE", 0.1)
+    result = bench.run_rung_sim_scale_10k()
+    assert result["mode"] == "virtual"
+    assert result["targets"] == 2000 and result["shards"] == 4
+    # the gate values travel with the result (perfgates is the source)
+    assert result["compression_floor"] == 4.0
+    assert result["compression_ratio"] >= result["compression_floor"]
+    assert result["query_p95_ms"] <= result["query_p95_budget_ms"]
+    assert result["shards_disjoint"] and result["shards_cover_fleet"]
+    assert result["federated_scan_p95_ms"] > 0.0
+    assert result["peak_retained_bytes"] > 0
+    # bytes/sample beats the uncompressed 16-byte pair by the gate margin
+    assert result["bytes_per_sample"] <= 16.0 / result["compression_floor"]
+    assert result["ok"] is True

@@ -1,0 +1,346 @@
+"""Sharded scraping + federation (metrics/federation.py, ISSUE 6).
+
+What is pinned here, mechanism by mechanism:
+
+- **hash ring**: deterministic across instances, disjoint ownership whose
+  union covers any fleet, balanced to within sane bounds at fleet sizes;
+- **plane as Scraper drop-in**: a sharded scrape of a fleet ingests the
+  same samples a single scraper would (values, labels, up-series), just
+  distributed;
+- **federated reads**: concatenated vectors, single-series ``latest``
+  semantics (including the >1-match raise), version sums monotonic so
+  incremental rule eval stays exact across the federation boundary;
+- **the federation rule pattern**: per-shard sum/count pre-reductions +
+  the global ``Ratio`` divide equal the unsharded fleet average exactly;
+- **lineage**: capture brackets fan out, so a global rule's read of
+  shard-recorded points chains to shard rule spans, which chain to
+  scrapes — the full trace contract runs sharded in test_simulate-style
+  form via ``run_scenario``;
+- **doctor**: the ``check_shards`` probe passes on a healthy plane and
+  names the broken invariant (dupe owner / orphan target) otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from k8s_gpu_hpa_tpu.doctor import check_shards
+from k8s_gpu_hpa_tpu.metrics.federation import (
+    FederatedTSDB,
+    HashRing,
+    ShardedScrapePlane,
+)
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    Aggregate,
+    Avg,
+    Ratio,
+    RecordingRule,
+    RuleEvaluator,
+    Select,
+)
+from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def lbl(**kw):
+    return tuple(sorted(kw.items()))
+
+
+def _gauge_fetch(name: str, value: float):
+    def fetch():
+        fam = MetricFamily("fleet_duty_cycle", "gauge")
+        fam.add(value, job="fleet", instance=name)
+        return [fam]
+
+    return fetch
+
+
+# ---- hash ring --------------------------------------------------------------
+
+
+def test_ring_is_deterministic_across_instances():
+    names = [f"fleet/synt-{i:04d}" for i in range(500)]
+    a, b = HashRing(8), HashRing(8)
+    assert [a.shard_for(n) for n in names] == [b.shard_for(n) for n in names]
+
+
+def test_ring_assignment_is_total_and_single_owner():
+    ring = HashRing(5)
+    for i in range(1000):
+        shard = ring.shard_for(f"t-{i}")
+        assert 0 <= shard < 5  # every key owned, by exactly one shard
+
+
+def test_ring_balance_within_sane_bounds():
+    ring = HashRing(8)
+    counts = [0] * 8
+    for i in range(10000):
+        counts[ring.shard_for(f"fleet/synt-{i:05d}")] += 1
+    # vnode smoothing: no shard should be starved or owning the world
+    assert min(counts) > 10000 / 8 / 3
+    assert max(counts) < 10000 / 8 * 3
+
+
+def test_ring_rejects_zero_shards():
+    with pytest.raises(ValueError):
+        HashRing(0)
+
+
+# ---- plane as Scraper drop-in ----------------------------------------------
+
+
+def _dump(db: TimeSeriesDB) -> dict:
+    out = {}
+    for name in db.series_names():
+        for s in db.instant_vector(name):
+            out[(name, s.labels)] = s.value
+    return out
+
+
+def test_sharded_scrape_ingests_what_a_single_scraper_would():
+    fleet = [(f"fleet/synt-{i:03d}", 30.0 + i) for i in range(40)]
+
+    clock_a = VirtualClock()
+    single_db = TimeSeriesDB(clock_a)
+    single = Scraper(single_db, interval=15.0)
+    for name, value in fleet:
+        single.add_target(_gauge_fetch(name, value), name=name)
+    clock_a.advance(15.0)
+    single.scrape_once()
+
+    clock_b = VirtualClock()
+    plane = ShardedScrapePlane(clock_b, shards=4, interval=15.0)
+    for name, value in fleet:
+        plane.add_target(_gauge_fetch(name, value), name=name)
+    clock_b.advance(15.0)
+    plane.scrape_once()
+
+    fed = FederatedTSDB(TimeSeriesDB(clock_b), plane.shard_dbs)
+    assert _dump(fed) == _dump(single_db)
+    assert len(plane.targets) == len(fleet)
+    # and the fleet is genuinely distributed, not piled on one shard
+    assert sum(1 for db in plane.shard_dbs if db.series_count()) > 1
+
+
+def test_shard_ownership_disjoint_and_covering():
+    plane = ShardedScrapePlane(VirtualClock(), shards=4)
+    names = [f"fleet/synt-{i:03d}" for i in range(100)]
+    for name in names:
+        plane.add_target(_gauge_fetch(name, 1.0), name=name)
+    status = plane.shard_status()
+    owned = [t for s in status["shards"] for t in s["targets"]]
+    assert sorted(owned) == sorted(names)  # disjoint AND covering
+    assert sorted(status["fleet"]) == sorted(names)
+
+
+# ---- federated reads --------------------------------------------------------
+
+
+def _two_shard_fed():
+    clock = VirtualClock()
+    shard_dbs = [TimeSeriesDB(clock), TimeSeriesDB(clock)]
+    fed = FederatedTSDB(TimeSeriesDB(clock), shard_dbs)
+    return clock, fed, shard_dbs
+
+
+def test_federated_vector_concatenates_across_members():
+    clock, fed, (s0, s1) = _two_shard_fed()
+    clock.advance(10.0)
+    s0.append("m", lbl(a="x"), 1.0)
+    s1.append("m", lbl(a="y"), 2.0)
+    fed.append("m", lbl(a="z"), 3.0)  # control-plane write -> global member
+    vec = fed.instant_vector("m")
+    assert {(s.labels, s.value) for s in vec} == {
+        (lbl(a="x"), 1.0),
+        (lbl(a="y"), 2.0),
+        (lbl(a="z"), 3.0),
+    }
+
+
+def test_federated_latest_single_series_and_ambiguity_raise():
+    clock, fed, (s0, s1) = _two_shard_fed()
+    clock.advance(10.0)
+    s0.append("m", lbl(a="x"), 1.0)
+    assert fed.latest("m", {"a": "x"}) == 1.0
+    assert fed.latest("m", {"a": "missing"}) is None
+    s1.append("m", lbl(a="y"), 2.0)
+    with pytest.raises(ValueError):
+        fed.latest("m")
+
+
+def test_federated_version_sum_is_monotonic_across_members():
+    clock, fed, (s0, s1) = _two_shard_fed()
+    clock.advance(10.0)
+    seen = [fed.version("m")]
+    s0.append("m", lbl(a="x"), 1.0)
+    seen.append(fed.version("m"))
+    s1.append("m", lbl(a="y"), 2.0)
+    seen.append(fed.version("m"))
+    fed.append("m", lbl(a="z"), 3.0)
+    seen.append(fed.version("m"))
+    assert seen == sorted(seen) and len(set(seen)) == len(seen)
+
+
+def test_incremental_rule_eval_skips_and_wakes_across_federation():
+    clock, fed, (s0, s1) = _two_shard_fed()
+    clock.advance(10.0)
+    s0.append("fleet_duty_cycle", lbl(job="fleet", instance="a"), 10.0)
+    s1.append("fleet_duty_cycle", lbl(job="fleet", instance="b"), 30.0)
+    rule = RecordingRule(
+        record="fleet_avg",
+        expr=Avg(Select("fleet_duty_cycle", {"job": "fleet"})),
+        labels={"deployment": "fleet"},
+    )
+    ev = RuleEvaluator(fed, [rule], interval=1.0)
+    ev.evaluate_once()
+    assert fed.latest("fleet_avg", {"deployment": "fleet"}) == 20.0
+    ev.evaluate_once()  # nothing changed in ANY member: signature skip
+    assert rule.skipped_evals == 1
+    s1.append("fleet_duty_cycle", lbl(job="fleet", instance="b"), 50.0)
+    ev.evaluate_once()  # a single shard's write wakes the rule
+    assert rule.full_evals == 2
+    assert fed.latest("fleet_avg", {"deployment": "fleet"}) == 30.0
+
+
+def test_capture_brackets_fan_out_to_every_member():
+    clock, fed, (s0, s1) = _two_shard_fed()
+    clock.advance(10.0)
+    s0.append("m", lbl(a="x"), 1.0, origin=7)
+    s1.append("m", lbl(a="y"), 2.0, origin=8)
+    fed.begin_capture()
+    fed.instant_vector("m")
+    captured = fed.end_capture()
+    assert {(name, labels, origin) for name, labels, _v, _ts, origin in captured} == {
+        ("m", lbl(a="x"), 7),
+        ("m", lbl(a="y"), 8),
+    }
+
+
+# ---- the federation rule pattern -------------------------------------------
+
+
+def test_ratio_expr_divides_and_handles_empty_and_zero():
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock)
+    clock.advance(10.0)
+    db.append("s", lbl(k="v"), 84.0)
+    db.append("c", lbl(k="v"), 2.0)
+    ratio = Ratio(Aggregate("sum", Select("s", {})), Aggregate("sum", Select("c", {})))
+    assert ratio.evaluate(db)[0].value == 42.0
+    assert "/" in ratio.promql()
+    assert ratio.input_names() == {"s", "c"}
+    empty = Ratio(Aggregate("sum", Select("nope", {})), Aggregate("sum", Select("c", {})))
+    assert empty.evaluate(db) == []
+    db.append("z", lbl(k="v"), 0.0)
+    zero_den = Ratio(Aggregate("sum", Select("s", {})), Aggregate("sum", Select("z", {})))
+    assert zero_den.evaluate(db) == []
+
+
+def test_shard_prereductions_plus_ratio_equal_unsharded_average():
+    from k8s_gpu_hpa_tpu.control.scale_harness import (
+        fleet_federated_rule,
+        fleet_shard_rules,
+    )
+
+    values = [30.0 + 7.0 * i for i in range(30)]
+    clock = VirtualClock()
+    plane = ShardedScrapePlane(clock, shards=3, interval=15.0)
+    for i, v in enumerate(values):
+        plane.add_target(_gauge_fetch(f"fleet/synt-{i:03d}", v), name=f"fleet/synt-{i:03d}")
+    plane.add_shard_rules(fleet_shard_rules, interval=5.0)
+    fed = FederatedTSDB(TimeSeriesDB(clock), plane.shard_dbs)
+    ev = RuleEvaluator(fed, [fleet_federated_rule()], interval=5.0)
+    clock.advance(15.0)
+    plane.scrape_once()
+    plane.evaluate_rules_once()
+    ev.evaluate_once()
+    got = fed.latest("fleet_duty_cycle_avg", {"deployment": "fleet"})
+    assert got == pytest.approx(sum(values) / len(values))
+
+
+# ---- doctor probe -----------------------------------------------------------
+
+
+def _healthy_status() -> dict:
+    plane = ShardedScrapePlane(VirtualClock(), shards=3)
+    for i in range(30):
+        plane.add_target(_gauge_fetch(f"t-{i}", 1.0), name=f"t-{i}")
+    return plane.shard_status()
+
+
+def test_check_shards_passes_on_healthy_plane():
+    detail = check_shards(json.dumps(_healthy_status()))
+    assert "3 shards reachable" in detail
+
+
+def test_check_shards_names_the_broken_invariant():
+    status = _healthy_status()
+    dupe = status["shards"][0]["targets"][0]
+    status["shards"][1]["targets"].append(dupe)
+    with pytest.raises(AssertionError, match="more than one shard"):
+        check_shards(json.dumps(status))
+
+    status = _healthy_status()
+    status["fleet"].append("ghost-target")
+    with pytest.raises(AssertionError, match="owned by no shard"):
+        check_shards(json.dumps(status))
+
+    status = _healthy_status()
+    status["shards"][2]["reachable"] = False
+    with pytest.raises(AssertionError, match="unreachable"):
+        check_shards(json.dumps(status))
+
+    with pytest.raises(AssertionError, match="no shards"):
+        check_shards(json.dumps({"shards": [], "fleet": []}))
+
+
+# ---- the whole plane, end to end -------------------------------------------
+
+
+def test_sharded_pipeline_scales_like_the_unsharded_one():
+    """The sim_scale contract at smoke size, sharded: same scaling decisions,
+    ring invariants held, compression on the sharded plane too."""
+    from k8s_gpu_hpa_tpu.control.scale_harness import run_fleet_scale
+
+    base = run_fleet_scale(targets=60, horizon_s=300.0)
+    sharded = run_fleet_scale(targets=60, horizon_s=300.0, shards=3)
+    assert sharded["final_replicas"] == base["final_replicas"]
+    assert sharded["scale_events"] == base["scale_events"]
+    assert sharded["fleet_vector_size"] == 60
+    assert sharded["shards_disjoint"] and sharded["shards_cover_fleet"]
+    assert sharded["compression_ratio"] > 2.0  # tiny run; full gate is 4x
+
+
+def test_sharded_trace_scenario_keeps_lineage_complete():
+    """The observability contract against the sharded plane: every scale
+    event's lineage walks back to raw exporter samples THROUGH the
+    federation (global rule read -> shard scrape spans)."""
+    import yaml
+
+    from k8s_gpu_hpa_tpu.obs import index_spans, lineage_of
+    from k8s_gpu_hpa_tpu.simulate import run_scenario
+
+    hpa_doc = yaml.safe_load(open("deploy/tpu-test-hpa.yaml").read())
+    report = run_scenario(hpa_doc, scenario="spike", duration=120.0, trace=True, shards=2)
+    tracer = report.tracer
+    events = tracer.spans_of("scale_event")
+    assert events, "spike must scale"
+    by_id = index_spans(tracer.spans)
+    assert all(lineage_of(ev, by_id)["complete"] for ev in events)
+
+
+def test_sharded_pipeline_refuses_restart_tsdb():
+    from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+    from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("n0", 4)])
+    dep = SimDeployment(cluster, "tpu-test", "tpu-test", load_fn=lambda t: 50.0)
+    cluster.add_deployment(dep, replicas=1)
+    clock.advance(15.0)
+    pipe = AutoscalingPipeline(cluster, dep, scrape_shards=2)
+    with pytest.raises(RuntimeError, match="shard"):
+        pipe.restart_tsdb()

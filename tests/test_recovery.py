@@ -391,3 +391,145 @@ def test_simulate_drill_cli_exit_codes():
     assert main(ns) == 0
     ns.components = "flux-capacitor"
     assert main(ns) == 2
+
+
+# ---- snapshot format negotiation (ISSUE 6: columnar storage) ----------------
+
+
+def test_v1_snapshot_replays_into_columnar_tsdb(tmp_path):
+    """A pre-columnar (format-1) snapshot — per-point [ts, value|null,
+    origin] triples, no ``format`` field — must restore into the columnar
+    engine with identical observable state: the negotiation path that lets
+    old WAL directories survive the storage rewrite."""
+    payload = {
+        # no "format" key: that IS the v1 signature
+        "at": 100.0,
+        "lookback": 300.0,
+        "retention": 600.0,
+        "series": [
+            {
+                "name": "tpu_duty_cycle",
+                "labels": [["chip", "0"], ["node", "n0"]],
+                "points": [[float(i * 15), 30.0 + i, i if i % 2 else None] for i in range(10)],
+            },
+            {
+                "name": "tpu_test_avg",
+                "labels": [["deployment", "d"], ["namespace", "default"]],
+                # a NaN staleness marker travels as null in v1
+                "points": [[0.0, 40.0, None], [15.0, None, None], [30.0, 41.0, 7]],
+            },
+        ],
+        "versions": {"tpu_duty_cycle": 10, "tpu_test_avg": 3},
+        "stale_pending": [["tpu_duty_cycle", [["chip", "0"], ["node", "n0"]], 99.0]],
+        "exemplars": [],
+    }
+    wal = WriteAheadLog(tmp_path / "wal")
+    wal.write_snapshot(payload)
+    wal.close()
+
+    recovered = TimeSeriesDB.recover(
+        WriteAheadLog(tmp_path / "wal"), VirtualClock(), chunk_size=4
+    )
+    assert recovered.last_recovery["snapshot_restored"] is True
+    # the reference: the same points appended live into a columnar DB
+    reference = TimeSeriesDB(VirtualClock(), retention=600.0, chunk_size=4)
+    for entry in payload["series"]:
+        labels = tuple((k, v) for k, v in entry["labels"])
+        for ts, value, origin in entry["points"]:
+            reference.append(
+                entry["name"],
+                labels,
+                float("nan") if value is None else value,
+                ts=ts,
+                origin=origin,
+            )
+    assert _state(recovered, at=135.0) == {
+        **_state(reference, at=135.0),
+        # versions come from the payload, not the replay counter
+        "version:tpu_duty_cycle": 10,
+        "version:tpu_test_avg": 3,
+    }
+    # the v1 points now live in sealed Gorilla chunks (chunk_size=4 forced
+    # seals), origins preserved through the re-encode
+    series = recovered._data["tpu_duty_cycle"][(("chip", "0"), ("node", "n0"))]
+    assert len(series.chunks) >= 2
+    assert series.points[1][2] == 1
+    assert recovered._stale_pending == {
+        ("tpu_duty_cycle", (("chip", "0"), ("node", "n0"))): 99.0
+    }
+
+
+def test_v2_snapshot_round_trips_chunk_blobs_bit_exact(tmp_path):
+    """Format-2 snapshots carry the compressed columns verbatim: sealed
+    chunk blobs must come back byte-identical (no re-encode on the restore
+    path), the resumed head must keep appending, and NaN/±inf values must
+    survive the JSON crossing exactly."""
+    clock = VirtualClock()
+    wal = WriteAheadLog(tmp_path / "wal")
+    db = TimeSeriesDB(clock, wal=wal, chunk_size=4)
+    values = [1.5, float("inf"), float("nan"), -0.0, 2.5, 3.5, float("-inf"), 4.5, 5.5]
+    for i, v in enumerate(values):
+        clock.advance(15.0)
+        db.append("m", (("a", "x"),), v, origin=i)
+    db.snapshot()
+    wal.close()
+
+    recovered = TimeSeriesDB.recover(
+        WriteAheadLog(tmp_path / "wal"), VirtualClock(), chunk_size=4
+    )
+    src = db._data["m"][(("a", "x"),)]
+    dst = recovered._data["m"][(("a", "x"),)]
+    assert [(c.ts_blob, c.val_blob, c.count, c.ts_mode) for c in dst.chunks] == [
+        (c.ts_blob, c.val_blob, c.count, c.ts_mode) for c in src.chunks
+    ]
+    assert len(dst.points) == len(src.points)
+    # bit-exact values incl. the specials, origins intact
+    import struct
+
+    def bits(x):
+        return struct.unpack("<Q", struct.pack("<d", x))[0]
+
+    assert [bits(p[1]) for p in dst.points] == [bits(v) for v in values]
+    assert [p[2] for p in dst.points] == list(range(len(values)))
+    # the resumed head encoder accepts further appends seamlessly
+    recovered.append("m", (("a", "x"),), 6.5, ts=15.0 * len(values) + 15.0)
+    assert recovered._data["m"][(("a", "x"),)].points[-1][1] == 6.5
+
+
+def test_kill_at_any_byte_with_chunk_seals_recovers(tmp_path):
+    """The kill-at-any-byte property with chunk_size=4, so WAL replay
+    crosses many seal boundaries: whatever byte the crash lands on, the
+    recovered DB equals a reference fed exactly the landed records."""
+    wal_dir = tmp_path / "wal"
+    wal = WriteAheadLog(wal_dir, segment_max_records=16)
+    db = TimeSeriesDB(VirtualClock(), wal=wal, chunk_size=4)
+    _populate(db)
+    wal.close()
+
+    segments = sorted(wal_dir.glob("wal-*.jsonl"))
+    final_bytes = segments[-1].read_bytes()
+    prefix_records: list[dict] = []
+    for seg in segments[:-1]:
+        for line in seg.read_text().splitlines():
+            prefix_records.append(json.loads(line))
+
+    for cut in list(range(0, len(final_bytes), 29)) + [len(final_bytes)]:
+        case_dir = tmp_path / f"seal-cut-{cut}"
+        shutil.copytree(wal_dir, case_dir)
+        (case_dir / segments[-1].name).write_bytes(final_bytes[:cut])
+        recovered = TimeSeriesDB.recover(
+            WriteAheadLog(case_dir), VirtualClock(), chunk_size=4
+        )
+        landed = list(prefix_records)
+        for line in final_bytes[:cut].split(b"\n"):
+            if not line:
+                continue
+            try:
+                landed.append(json.loads(line))
+            except ValueError:
+                pass
+        reference = TimeSeriesDB(VirtualClock(), chunk_size=4)
+        _apply_records(reference, landed)
+        assert _state(recovered, at=59.0) == _state(reference, at=59.0), (
+            f"cut at byte {cut}: recovered state diverged (chunk_size=4)"
+        )

@@ -1,21 +1,28 @@
 """Profile/assert harness for the fleet-scale metrics plane.
 
 Runs ``control/scale_harness.run_fleet_scale`` standalone — no bench.py,
-no jax import — so it doubles as the tier-1 ``sim_scale`` smoke and as a
-cProfile entry point when the plane regresses:
+no jax import — so it doubles as the tier-1 ``sim_scale`` /
+``sim_scale_10k`` smokes and as a cProfile entry point when the plane
+regresses:
 
 Usage:
     python tools/profile_sim.py                          # full 1000x1h run
     python tools/profile_sim.py --targets 200 --horizon 600
     python tools/profile_sim.py --profile                # cProfile top-25
     python tools/profile_sim.py --json                   # machine output
-    python tools/profile_sim.py --targets 100 --horizon 600 \
-        --assert-min-speedup 20 --assert-max-points 40000   # CI smoke
+    python tools/profile_sim.py --smoke --assert-gates   # tier-1 smoke
+    python tools/profile_sim.py --preset sim_scale_10k --smoke \
+        --assert-gates                                   # sharded smoke
 
-The assert flags turn the report into a pass/fail gate: exit 1 (with the
-numbers printed) when the virtual/wall speedup drops below the floor or
-the retained-point peak exceeds the bound — i.e. retention stopped
-trimming or a hot path went quadratic.
+Every threshold comes from ``k8s_gpu_hpa_tpu.perfgates`` — the single
+shared constants module — so re-baselining a gate is one edit there, not
+a hunt through shell scripts.  ``--assert-gates`` applies the preset's
+gates (speedup floor and retained-point bound for ``sim_scale``; those
+plus the compression-ratio, fleet-query-p95, and appends/sec gates for
+``sim_scale_10k``); the explicit ``--assert-*`` flags override individual
+values.  Exit 1 (with the numbers printed) on any violated gate — i.e.
+retention stopped trimming, a hot path went quadratic, or compression
+silently fell back to raw.
 """
 
 from __future__ import annotations
@@ -27,19 +34,80 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from k8s_gpu_hpa_tpu import perfgates
 from k8s_gpu_hpa_tpu.control.scale_harness import run_fleet_scale
+
+#: per-preset (full-sizing, smoke-sizing) — each (targets, horizon, shards)
+_SIZINGS = {
+    "sim_scale": (
+        (perfgates.SIM_SCALE_TARGETS, perfgates.SIM_SCALE_HORIZON_S, 0),
+        (perfgates.PROFILE_SMOKE_TARGETS, perfgates.PROFILE_SMOKE_HORIZON_S, 0),
+    ),
+    "sim_scale_10k": (
+        (
+            perfgates.SIM_SCALE_10K_TARGETS,
+            perfgates.SIM_SCALE_10K_HORIZON_S,
+            perfgates.SIM_SCALE_10K_SHARDS,
+        ),
+        (
+            perfgates.SIM_SCALE_10K_SMOKE_TARGETS,
+            perfgates.SIM_SCALE_10K_SMOKE_HORIZON_S,
+            perfgates.SIM_SCALE_10K_SMOKE_SHARDS,
+        ),
+    ),
+}
+
+
+def _gates(preset: str, smoke: bool) -> dict:
+    """The preset's assert-gate values (``None`` = not gated)."""
+    if preset == "sim_scale":
+        return {
+            "min_speedup": perfgates.PROFILE_SMOKE_MIN_SPEEDUP
+            if smoke
+            else perfgates.SIM_SCALE_MIN_SPEEDUP,
+            "max_points": perfgates.PROFILE_SMOKE_MAX_POINTS if smoke else None,
+            "min_compression": None,
+            "max_query_p95_ms": None,
+            "min_appends_per_sec": None,
+        }
+    return {
+        "min_speedup": perfgates.SIM_SCALE_10K_SMOKE_MIN_SPEEDUP
+        if smoke
+        else perfgates.SIM_SCALE_10K_MIN_SPEEDUP,
+        "max_points": None,
+        "min_compression": perfgates.MIN_COMPRESSION_RATIO,
+        "max_query_p95_ms": perfgates.MAX_FLEET_QUERY_P95_MS,
+        "min_appends_per_sec": perfgates.MIN_APPENDS_PER_SEC,
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--targets", type=int, default=1000)
-    parser.add_argument("--horizon", type=float, default=3600.0)
+    parser.add_argument(
+        "--preset",
+        choices=sorted(_SIZINGS),
+        default="sim_scale",
+        help="which rung's sizing and gates to use",
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="smoke sizing for the preset (same code paths, ~10-20x less work)",
+    )
+    parser.add_argument("--targets", type=int, default=None)
+    parser.add_argument("--horizon", type=float, default=None)
+    parser.add_argument("--shards", type=int, default=None)
     parser.add_argument("--scrape-interval", type=float, default=15.0)
     parser.add_argument("--rule-interval", type=float, default=5.0)
     parser.add_argument(
         "--profile", action="store_true", help="run under cProfile, print top-25"
     )
     parser.add_argument("--json", action="store_true", help="emit one JSON object")
+    parser.add_argument(
+        "--assert-gates",
+        action="store_true",
+        help="apply the preset's perfgates thresholds",
+    )
     parser.add_argument(
         "--assert-min-speedup",
         type=float,
@@ -54,12 +122,18 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    sizing = _SIZINGS[args.preset][1 if args.smoke else 0]
+    targets = sizing[0] if args.targets is None else args.targets
+    horizon = sizing[1] if args.horizon is None else args.horizon
+    shards = sizing[2] if args.shards is None else args.shards
+
     def run() -> dict:
         return run_fleet_scale(
-            targets=args.targets,
-            horizon_s=args.horizon,
+            targets=targets,
+            horizon_s=horizon,
             scrape_interval=args.scrape_interval,
             rule_interval=args.rule_interval,
+            shards=shards,
         )
 
     if args.profile:
@@ -78,22 +152,58 @@ def main(argv: list[str] | None = None) -> int:
         for key, value in result.items():
             print(f"{key:>24}: {value}")
 
+    gates = (
+        _gates(args.preset, args.smoke)
+        if args.assert_gates
+        else dict.fromkeys(_gates(args.preset, args.smoke))
+    )
+    if args.assert_min_speedup is not None:
+        gates["min_speedup"] = args.assert_min_speedup
+    if args.assert_max_points is not None:
+        gates["max_points"] = args.assert_max_points
+
     failures = []
-    if (
-        args.assert_min_speedup is not None
-        and result["speedup"] < args.assert_min_speedup
-    ):
+    if gates["min_speedup"] is not None and result["speedup"] < gates["min_speedup"]:
         failures.append(
-            f"speedup {result['speedup']} < floor {args.assert_min_speedup}"
+            f"speedup {result['speedup']} < floor {gates['min_speedup']}"
         )
     if (
-        args.assert_max_points is not None
-        and result["peak_retained_points"] > args.assert_max_points
+        gates["max_points"] is not None
+        and result["peak_retained_points"] > gates["max_points"]
     ):
         failures.append(
             f"peak_retained_points {result['peak_retained_points']} > "
-            f"bound {args.assert_max_points}"
+            f"bound {gates['max_points']}"
         )
+    if (
+        gates["min_compression"] is not None
+        and result["compression_ratio"] < gates["min_compression"]
+    ):
+        failures.append(
+            f"compression_ratio {result['compression_ratio']} < "
+            f"floor {gates['min_compression']}"
+        )
+    if (
+        gates["max_query_p95_ms"] is not None
+        and result["query_p95_ms"] > gates["max_query_p95_ms"]
+    ):
+        failures.append(
+            f"query_p95_ms {result['query_p95_ms']} > "
+            f"budget {gates['max_query_p95_ms']}"
+        )
+    if (
+        gates["min_appends_per_sec"] is not None
+        and result["appends_per_sec"] < gates["min_appends_per_sec"]
+    ):
+        failures.append(
+            f"appends_per_sec {result['appends_per_sec']} < "
+            f"floor {gates['min_appends_per_sec']}"
+        )
+    if shards:
+        if not result.get("shards_disjoint", False):
+            failures.append("shard target sets are not disjoint")
+        if not result.get("shards_cover_fleet", False):
+            failures.append("shard union does not cover the fleet")
     for failure in failures:
         print(f"ASSERT FAILED: {failure}", file=sys.stderr)
     return 1 if failures else 0

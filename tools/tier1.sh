@@ -13,10 +13,16 @@ set -o pipefail
 python tools/lint_trace_schema.py --selfcheck || exit 1
 # sim_scale smoke: the fleet-scale metrics plane must stay fast (virtual/wall
 # speedup floor) and bounded (retention must keep trimming); small sizing —
-# the full 1000x1h rung runs in bench.py (~8000x observed here, floor 20x
-# absorbs CI-host noise; the point bound is deterministic, observed 14815)
-python tools/profile_sim.py --targets 100 --horizon 600 \
-  --assert-min-speedup 20 --assert-max-points 25000 || exit 1
+# the full 1000x1h rung runs in bench.py.  All thresholds live in
+# k8s_gpu_hpa_tpu/perfgates.py (the shared constants module), applied by
+# --assert-gates
+python tools/profile_sim.py --smoke --assert-gates || exit 1
+# sim_scale_10k smoke: the sharded federation plane (hash-ring scraper
+# shards over columnar Gorilla-compressed TSDBs) at 2000x10min/4-shard
+# sizing — gates the compression ratio (>=4x vs uncompressed), the fleet
+# query p95 budget, the appends/sec floor, and the ring invariants
+# (disjoint shard ownership covering the fleet); thresholds from perfgates
+python tools/profile_sim.py --preset sim_scale_10k --smoke --assert-gates || exit 1
 # fault-registry lint: every chaos fault kind must have an injector, a
 # docstring row, and at least one test referencing it
 python tools/lint_faults.py || exit 1
