@@ -238,6 +238,12 @@ def main(argv: list[str] | None = None) -> int:
         help="run the scenario against a sharded scrape plane with N "
         "hash-ring scraper shards (0 = single scraper)",
     )
+    sim.add_argument(
+        "--explain",
+        action="store_true",
+        help="print the query planner's physical plan for every rule and "
+        "alert the pipeline evaluates (see ARCHITECTURE.md: query engine)",
+    )
 
     genm = sub.add_parser(
         "gen-manifests", help="check or write the generated shipped manifests"

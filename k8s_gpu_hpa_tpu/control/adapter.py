@@ -88,6 +88,7 @@ class CustomMetricsAdapter:
         external_rules: list[ExternalRule] | None = None,
         tracer=None,
         selfmetrics=None,
+        planner=None,
     ):
         self.db = db
         self.rules = {r.metric_name: r for r in rules}
@@ -98,6 +99,26 @@ class CustomMetricsAdapter:
         #: obs.PipelineSelfMetrics: query-duration histogram with the
         #: adapter_query span as each observation's exemplar
         self.selfmetrics = selfmetrics
+        #: metrics.planner.QueryPlanner: when set, every instant read goes
+        #: through a planned IndexScan cached per (series, matchers) — the
+        #: HPA's steady-state poll repeats the same handful of queries, so
+        #: the series set resolves through the inverted index once
+        self.planner = planner
+        self._plan_cache: dict[tuple, object] = {}
+
+    def _vector(self, series: str, matchers: dict[str, str] | None = None):
+        """One instant read — planned when a planner is wired, the plain
+        ``instant_vector`` surface otherwise (bit-identical either way)."""
+        if self.planner is None:
+            return self.db.instant_vector(series, matchers)
+        key = (series, tuple(sorted((matchers or {}).items())))
+        plan = self._plan_cache.get(key)
+        if plan is None:
+            from k8s_gpu_hpa_tpu.metrics.rules import Select
+
+            plan = self.planner.plan(Select(series, dict(matchers or {})))
+            self._plan_cache[key] = plan
+        return plan.evaluate(self.db)
 
     def _traced(self, api: str, metric: str, query, found):
         """Run ``query`` under an ``adapter_query`` span whose links are the
@@ -130,7 +151,7 @@ class CustomMetricsAdapter:
         reference's raw-API probe greps for (README.md:101)."""
         available = []
         for name, rule in self.rules.items():
-            if self.db.instant_vector(rule.series):
+            if self._vector(rule.series):
                 available.append(name)
         return sorted(available)
 
@@ -139,7 +160,7 @@ class CustomMetricsAdapter:
         return sorted(
             name
             for name, rule in self.external_rules.items()
-            if self.db.instant_vector(rule.series)
+            if self._vector(rule.series)
         )
 
     def get_object_metric(self, ref: ObjectReference, metric_name: str) -> float | None:
@@ -167,7 +188,7 @@ class CustomMetricsAdapter:
                 break
         else:
             return None
-        vec = self.db.instant_vector(rule.series, matchers)
+        vec = self._vector(rule.series, matchers)
         if not vec:
             return None
         if len(vec) > 1:
@@ -211,7 +232,7 @@ class CustomMetricsAdapter:
             return {}
         out: dict[str, float] = {}
         for name in pod_names:
-            vec = self.db.instant_vector(
+            vec = self._vector(
                 rule.series, {"namespace": namespace, pod_label: name}
             )
             if not vec:
@@ -250,4 +271,4 @@ class CustomMetricsAdapter:
             return []
         matchers = {"namespace": namespace}
         matchers.update(selector or {})
-        return [s.value for s in self.db.instant_vector(rule.series, matchers)]
+        return [s.value for s in self._vector(rule.series, matchers)]

@@ -186,6 +186,16 @@ class AutoscalingPipeline:
 
             slo_recorders = shipped_slo_recorders()
             alerts = shipped_slo_alerts()
+        # Query planner (ISSUE 7): one planner over the pipeline's DB view
+        # (the FederatedTSDB on sharded pipelines) compiles every rule and
+        # adapter query into a physical plan once; the evaluator and the
+        # adapter both execute plans thereafter.  Its counters feed the
+        # self-metrics exporter and the doctor's check_query_planner probe.
+        from k8s_gpu_hpa_tpu.metrics.planner import QueryPlanner
+
+        self.planner = QueryPlanner(self.db)
+        if self.selfmetrics is not None:
+            self.selfmetrics.attach_query_engine(self.planner.stats, self.db)
         self.evaluator = RuleEvaluator(
             self.db,
             rules + slo_recorders,
@@ -193,6 +203,7 @@ class AutoscalingPipeline:
             alerts=alerts,
             tracer=tracer,
             selfmetrics=self.selfmetrics,
+            planner=self.planner,
         )
 
         def overrides_for(rule: RecordingRule) -> dict[str, str]:
@@ -219,6 +230,7 @@ class AutoscalingPipeline:
             + (extra_adapter_rules or []),
             tracer=tracer,
             selfmetrics=self.selfmetrics,
+            planner=self.planner,
         )
 
         ref = ObjectReference(object_kind, deployment.name, deployment.namespace)
@@ -342,6 +354,13 @@ class AutoscalingPipeline:
         self.scraper.db = db
         self.evaluator.db = db
         self.adapter.db = db
+        # cached plans hold series sets resolved against the dead DB; the
+        # member-identity check would catch it per-eval, but a restart is
+        # the one moment a wholesale drop is obviously right
+        self.planner.invalidate()
+        self.adapter._plan_cache.clear()
+        if self.selfmetrics is not None:
+            self.selfmetrics.attach_query_engine(self.planner.stats, db)
         self.scraper.stagger_after_recovery()
         return self._log_restart("tsdb", info)
 
@@ -384,6 +403,7 @@ class AutoscalingPipeline:
             external_rules=list(old.external_rules.values()),
             tracer=old.tracer,
             selfmetrics=old.selfmetrics,
+            planner=old.planner,
         )
         self.hpa.adapter = self.adapter
         return self._log_restart("adapter", {})

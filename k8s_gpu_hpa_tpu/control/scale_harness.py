@@ -284,6 +284,167 @@ def run_fleet_scale(
     return result
 
 
+# ---- query bench (ISSUE 7: planned vs naive rule evaluation) ----------------
+
+
+def _vectors_identical(a, b) -> bool:
+    """Bit-identical vector equality: same length, same order, same labels,
+    and per-sample float equality (NaN matching NaN)."""
+    return len(a) == len(b) and all(
+        x.labels == y.labels
+        and (x.value == y.value or (x.value != x.value and y.value != y.value))
+        for x, y in zip(a, b)
+    )
+
+
+def run_query_bench(
+    targets: int = 1000,
+    shards: int = 4,
+    horizon_s: float = 1800.0,
+    scrape_interval: float = 5.0,
+    window_s: float | None = None,
+    iters: int = 3,
+    p95_iters: int = 30,
+) -> dict:
+    """Planned-vs-naive evaluation of the fleet-wide aggregate rule basket
+    over a populated sharded TSDB — the ``query_bench`` rung's payload.
+
+    The population mirrors the sim_scale_10k steady state: ``targets``
+    fleet series spread round-robin across ``shards`` shard DBs behind a
+    ``FederatedTSDB``, scraped at ``scrape_interval`` for ``horizon_s``
+    virtual seconds, so each series ends with several sealed Gorilla chunks
+    plus a live head.  The basket is the two fleet-aggregate shapes rules
+    actually run: the instant fleet average and a windowed
+    ``avg(avg_over_time(...))`` whose window covers most sealed chunks in
+    full (the chunk-summary pushdown case) but starts mid-chunk (so the
+    boundary-decode path stays honest).
+
+    Both paths evaluate the SAME logical exprs at the SAME instant;
+    ``identical`` asserts the result vectors are bit-identical before any
+    timing is trusted.  ``query_p95_ms`` times the steady-state planned
+    queries the sharded plane serves — per-shard fleet scans plus the
+    federated single-series read — against
+    ``perfgates.MAX_FLEET_QUERY_P95_MS``."""
+    from k8s_gpu_hpa_tpu.metrics.federation import FederatedTSDB
+    from k8s_gpu_hpa_tpu.metrics.planner import QueryPlanner
+    from k8s_gpu_hpa_tpu.metrics.rules import AvgOverTime
+    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+
+    if window_s is None:
+        # cover all but the first ~300 s so the window starts mid-chunk
+        window_s = horizon_s - 300.0
+    clock = VirtualClock()
+    retention = horizon_s + 60.0
+    global_db = TimeSeriesDB(clock, retention=retention)
+    shard_dbs = [TimeSeriesDB(clock, retention=retention) for _ in range(shards)]
+    db = FederatedTSDB(global_db, shard_dbs)
+
+    labels = [
+        tuple(sorted({"job": "fleet", "instance": f"synt-{i:05d}"}.items()))
+        for i in range(targets)
+    ]
+    ts = 0.0
+    for tick in range(int(horizon_s / scrape_interval)):
+        ts += scrape_interval
+        clock.advance(scrape_interval)
+        for i, lbl in enumerate(labels):
+            shard_dbs[i % shards].append(
+                "fleet_duty_cycle", lbl, 30.0 + (i % 40) + 5.0 * (tick % _VARIANTS), ts
+            )
+    # the adapter's steady-state read target: the recorded fleet aggregate
+    # (rule outputs land in the global member on a sharded plane)
+    global_db.append(
+        "fleet_duty_cycle_avg",
+        tuple(sorted({"namespace": "default", "deployment": "fleet"}.items())),
+        42.0,
+        ts,
+    )
+
+    basket = {
+        "instant": Avg(Select("fleet_duty_cycle", {"job": "fleet"})),
+        "range": Avg(AvgOverTime("fleet_duty_cycle", window_s, {"job": "fleet"})),
+    }
+    planner = QueryPlanner(db)
+    at = clock.now()
+
+    # warmup + the identity check the speedup claim rests on
+    identical = True
+    for expr in basket.values():
+        naive_vec = expr.evaluate(db, at)
+        planned_vec = planner.plan(expr).evaluate(db, at)
+        identical = identical and _vectors_identical(naive_vec, planned_vec)
+
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        per_expr = {}
+        naive_total = planned_total = 0.0
+        for key, expr in basket.items():
+            plan = planner.plan(expr)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                expr.evaluate(db, at)
+            naive_s = (time.perf_counter() - t0) / iters
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                plan.evaluate(db, at)
+            planned_s = (time.perf_counter() - t0) / iters
+            naive_total += naive_s
+            planned_total += planned_s
+            per_expr[key] = {
+                "naive_ms": round(naive_s * 1e3, 3),
+                "planned_ms": round(planned_s * 1e3, 3),
+                "speedup": round(naive_s / planned_s, 2) if planned_s else 0.0,
+            }
+
+        # steady-state fleet queries: per-shard planned scans + the
+        # adapter's federated single-series read (one plan per shard DB —
+        # a plan's series cache binds to the view it evaluates against)
+        shard_plans = [
+            planner.plan(Select("fleet_duty_cycle", {"job": "fleet"}))
+            for _ in shard_dbs
+        ]
+        single_plan = planner.plan(
+            Select("fleet_duty_cycle_avg", {"deployment": "fleet"})
+        )
+        query_times_ms: list[float] = []
+        for _ in range(p95_iters):
+            for shard_db, plan in zip(shard_dbs, shard_plans):
+                q0 = time.perf_counter()
+                plan.evaluate(shard_db, at)
+                query_times_ms.append((time.perf_counter() - q0) * 1e3)
+            q0 = time.perf_counter()
+            single_plan.evaluate(db, at)
+            query_times_ms.append((time.perf_counter() - q0) * 1e3)
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+
+    query_times_ms.sort()
+    stats = planner.stats
+    return {
+        "targets": targets,
+        "shards": shards,
+        "horizon_s": horizon_s,
+        "window_s": window_s,
+        "retained_points": db.total_points(),
+        "identical": identical,
+        "exprs": per_expr,
+        "naive_ms": round(naive_total * 1e3, 3),
+        "planned_ms": round(planned_total * 1e3, 3),
+        "speedup": round(naive_total / planned_total, 2) if planned_total else 0.0,
+        "query_p50_ms": round(_percentile(query_times_ms, 0.50), 4),
+        "query_p95_ms": round(_percentile(query_times_ms, 0.95), 4),
+        "planner_fastpath": stats.fastpath,
+        "planner_fallback": stats.fallback,
+        "series_cache_hits": stats.series_cache_hits,
+        "series_resolves": stats.series_resolves,
+        "plans_built": stats.plans_built,
+        "decode_cache_hits": db.decode_cache_hits,
+        "decode_cache_misses": db.decode_cache_misses,
+    }
+
+
 # ---- recovery drill (ISSUE 4: durability under crash/restart) ---------------
 
 #: which restart fault each drillable component maps to

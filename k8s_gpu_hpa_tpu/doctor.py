@@ -304,6 +304,41 @@ def check_histograms(text: str) -> str:
     )
 
 
+def check_query_planner(payload: str) -> str:
+    """Query-engine health (pipelines running the planner,
+    metrics/planner.py): planned and naive evaluation of every rule agree
+    sample-for-sample, and the chunk-summary fast path is actually being
+    taken.  Disagreement means planned execution is computing DIFFERENT
+    numbers than the semantics the tests pin — the worst possible state,
+    since the HPA acts on whatever the planner returns; a zero fast-path
+    counter means the optimization silently stopped applying (seal-time
+    summaries missing, or every window degenerating to decode) and the
+    plane is paying full decode cost while looking healthy.  ``payload``
+    is ``planner_selfcheck(...)`` JSON."""
+    doc = json.loads(payload)
+    disagree = [r["record"] for r in doc.get("rules", []) if not r["agree"]]
+    if not doc.get("agree_all", False) or disagree:
+        raise AssertionError(
+            "planned evaluation DISAGREES with naive AST evaluation for: "
+            + (", ".join(disagree) or "(unreported rules)")
+            + " — do not trust scale decisions until this is fixed"
+        )
+    fastpath = doc.get("fastpath", 0)
+    fallback = doc.get("fallback", 0)
+    if fastpath <= 0:
+        raise AssertionError(
+            f"planner summary fast path never taken (fastpath=0, "
+            f"fallback={fallback}): windowed reads are decoding every chunk "
+            "— seal-time summaries are missing or the planner fell back"
+        )
+    return (
+        f"{len(doc.get('rules', []))} rules planned==naive; "
+        f"fastpath {fastpath} chunk(s), fallback {fallback} decode(s), "
+        f"series cache {doc.get('series_cache_hits', 0)} hit(s)/"
+        f"{doc.get('series_resolves', 0)} resolve(s)"
+    )
+
+
 def check_custom_metrics_api(payload: str, metric: str) -> str:
     """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
     doc = json.loads(payload)
@@ -397,6 +432,7 @@ def diagnose(
     self_metrics_fetch: Callable[[], str] | None = None,
     self_exposition_fetch: Callable[[], str] | None = None,
     shards_fetch: Callable[[], str] | None = None,
+    planner_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -436,6 +472,13 @@ def diagnose(
             "self-histograms cumulative, +Inf == _count, _sum consistent",
             (lambda: check_histograms(self_exposition_fetch()))
             if self_exposition_fetch
+            else None,
+        ),
+        (
+            "L3 query planner",
+            "planned rule evaluation bit-agrees with naive, fast path live",
+            (lambda: check_query_planner(planner_fetch()))
+            if planner_fetch
             else None,
         ),
         (

@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import zlib
 from bisect import bisect_right
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 from k8s_gpu_hpa_tpu.metrics.rules import RecordingRule, RuleEvaluator
@@ -107,6 +108,12 @@ class ShardedScrapePlane:
         ]
         #: per-shard rule evaluators (``add_shard_rules``), or None slots
         self.shard_evaluators: list[RuleEvaluator | None] = [None] * shards
+        #: evaluate shard rules concurrently (disjoint DBs make the passes
+        #: independent); automatically falls back to the serial loop when a
+        #: shard evaluator carries a shared tracer/selfmetrics sink, whose
+        #: internals are not thread-safe
+        self.parallel_rules = True
+        self._rule_pool: ThreadPoolExecutor | None = None
 
     # -- Scraper drop-in surface --------------------------------------------
 
@@ -156,20 +163,50 @@ class ShardedScrapePlane:
             if existing is not None:
                 existing.rules.extend(rules)
             else:
+                # one planner per shard, not a shared one: the parallel
+                # fan-out below would race a shared PlannerStats' counters
+                from k8s_gpu_hpa_tpu.metrics.planner import QueryPlanner
+
                 self.shard_evaluators[shard] = RuleEvaluator(
                     self.shard_dbs[shard],
                     rules,
                     interval=interval,
                     tracer=tracer,
                     selfmetrics=selfmetrics,
+                    planner=QueryPlanner(self.shard_dbs[shard]),
                 )
 
     def evaluate_rules_once(self) -> int:
         """One evaluation pass over every shard's local rules (the pipeline
         runs this before the global evaluator each rule tick, so federated
-        aggregates read fresh shard reductions)."""
+        aggregates read fresh shard reductions).
+
+        With two or more populated shards the passes fan out onto a shared
+        thread pool — shard DBs are disjoint by ring construction, and a
+        rule's incremental-eval state lives on the per-shard rule objects, so
+        the evaluations share nothing.  The fan-out is skipped when any
+        evaluator carries a tracer or selfmetrics sink (their span/list
+        internals are not guarded) or when ``parallel_rules`` is off."""
+        evaluators = [ev for ev in self.shard_evaluators if ev is not None]
+        if (
+            len(evaluators) < 2
+            or not self.parallel_rules
+            or any(
+                ev.tracer is not None or ev.selfmetrics is not None
+                for ev in evaluators
+            )
+        ):
+            return sum(ev.evaluate_once() for ev in evaluators)
+        pool = self._rule_pool
+        if pool is None or pool._max_workers < len(evaluators):
+            if pool is not None:
+                pool.shutdown(wait=True)
+            pool = self._rule_pool = ThreadPoolExecutor(
+                max_workers=len(evaluators),
+                thread_name_prefix="shard-rules",
+            )
         return sum(
-            ev.evaluate_once() for ev in self.shard_evaluators if ev is not None
+            pool.map(lambda ev: ev.evaluate_once(), evaluators)
         )
 
     # -- introspection (doctor check_shards) ---------------------------------
@@ -277,6 +314,27 @@ class FederatedTSDB:
                 out.extend(vec)
         return out
 
+    def range_avg(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        window_s: float = 0.0,
+        at: float | None = None,
+        use_summaries: bool = False,
+        stats=None,
+    ) -> list[Sample]:
+        at = self.clock.now() if at is None else at
+        out = self.global_db.range_avg(
+            name, matchers, window_s, at, use_summaries=use_summaries, stats=stats
+        )
+        for db in self.shard_dbs:
+            vec = db.range_avg(
+                name, matchers, window_s, at, use_summaries=use_summaries, stats=stats
+            )
+            if vec:
+                out.extend(vec)
+        return out
+
     def latest(self, name: str, matchers: dict[str, str] | None = None) -> float | None:
         vec = self.instant_vector(name, matchers)
         if not vec:
@@ -312,6 +370,17 @@ class FederatedTSDB:
 
     def version(self, name: str) -> int:
         return sum(db.version(name) for db in self.members)
+
+    def series_generation(self, name: str) -> int:
+        return sum(db.series_generation(name) for db in self.members)
+
+    @property
+    def decode_cache_hits(self) -> int:
+        return sum(db.decode_cache_hits for db in self.members)
+
+    @property
+    def decode_cache_misses(self) -> int:
+        return sum(db.decode_cache_misses for db in self.members)
 
     def total_points(self) -> int:
         return sum(db.total_points() for db in self.members)

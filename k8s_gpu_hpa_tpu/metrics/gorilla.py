@@ -105,7 +105,8 @@ class GorillaEncoder:
     """
 
     __slots__ = ("count", "ts_buf", "val_buf", "ts_mode",
-                 "_t_last", "_t_delta", "_v_bits")
+                 "_t_last", "_t_delta", "_v_bits",
+                 "_s_count", "_s_sum", "_s_min", "_s_max", "_s_nans")
 
     def __init__(self) -> None:
         self.count = 0
@@ -115,6 +116,24 @@ class GorillaEncoder:
         self._t_last = 0
         self._t_delta = 0
         self._v_bits = 0
+        self._s_count = 0
+        self._s_sum = 0.0
+        self._s_min = math.inf
+        self._s_max = -math.inf
+        self._s_nans = 0
+
+    def summary(self) -> "tuple | None":
+        """Running ``(count, sum, min, max, nan_count)`` over the head's
+        non-NaN values — the same left-to-right accumulation a decode-and-scan
+        of the sealed chunk would perform, so planned aggregation over the
+        sealed summary is bit-identical to the naive path (planner.py).
+        None while the head is empty."""
+        if self.count == 0:
+            return None
+        if self._s_count == 0:  # all points are NaN staleness markers
+            return (0, 0.0, None, None, self._s_nans)
+        return (self._s_count, self._s_sum, self._s_min, self._s_max,
+                self._s_nans)
 
     def append(self, ts: float, value: float) -> None:
         t = _ts_int(ts, self.ts_mode)
@@ -152,6 +171,15 @@ class GorillaEncoder:
         self._t_last = t
         self._v_bits = v_bits
         self.count += 1
+        if value != value:  # NaN staleness marker: excluded from aggregates
+            self._s_nans += 1
+        else:
+            self._s_count += 1
+            self._s_sum += value
+            if value < self._s_min:
+                self._s_min = value
+            if value > self._s_max:
+                self._s_max = value
 
     def _escape_to_bits(self) -> None:
         """Re-encode the timestamp column over bit patterns (values stay).
@@ -193,6 +221,11 @@ class GorillaEncoder:
         self._t_last = 0
         self._t_delta = 0
         self._v_bits = 0
+        self._s_count = 0
+        self._s_sum = 0.0
+        self._s_min = math.inf
+        self._s_max = -math.inf
+        self._s_nans = 0
 
     def restore(self, ts_blob: bytes, val_blob: bytes, count: int,
                 ts_mode: int = TS_NANOS) -> None:
@@ -202,10 +235,25 @@ class GorillaEncoder:
         self.ts_buf = bytearray(ts_blob)
         self.val_buf = bytearray(val_blob)
         self.ts_mode = ts_mode
+        self._s_count = 0
+        self._s_sum = 0.0
+        self._s_min = math.inf
+        self._s_max = -math.inf
+        self._s_nans = 0
         if count == 0:
             self._t_last = self._t_delta = self._v_bits = 0
             return
         ts_arr, val_arr = decode(ts_blob, val_blob, count, ts_mode)
+        for v in val_arr.tolist():  # left-to-right: matches append order
+            if v != v:
+                self._s_nans += 1
+            else:
+                self._s_count += 1
+                self._s_sum += v
+                if v < self._s_min:
+                    self._s_min = v
+                if v > self._s_max:
+                    self._s_max = v
         last = _ts_int(float(ts_arr[-1]), ts_mode)
         assert last is not None  # it came out of this very codec
         self._t_last = last
@@ -226,10 +274,16 @@ class GorillaChunk:
     do), else a tuple parallel to the decoded arrays.  ``_decoded`` caches
     the (ts, values) numpy pair; the owning TSDB bounds how many chunks hold
     a live cache at once.
+
+    ``summary`` is ``(count, sum, min, max, nan_count)`` over the chunk's
+    non-NaN values, accumulated left-to-right at seal time (the planner's
+    decode-free aggregation pushdown).  Chunks recovered from snapshots carry
+    None — the format-2 snapshot layout is positional and frozen — and the
+    planner recomputes it lazily via :meth:`ensure_summary`.
     """
 
     __slots__ = ("count", "ts_blob", "val_blob", "ts_mode",
-                 "first_ts", "last_ts", "origins", "_decoded")
+                 "first_ts", "last_ts", "origins", "summary", "_decoded")
 
     def __init__(
         self,
@@ -240,6 +294,7 @@ class GorillaChunk:
         last_ts: float,
         origins: tuple | None = None,
         ts_mode: int = TS_NANOS,
+        summary: tuple | None = None,
     ):
         self.count = count
         self.ts_blob = ts_blob
@@ -248,7 +303,16 @@ class GorillaChunk:
         self.first_ts = first_ts
         self.last_ts = last_ts
         self.origins = origins
+        self.summary = summary
         self._decoded: tuple[np.ndarray, np.ndarray] | None = None
+
+    def ensure_summary(self) -> tuple:
+        """The chunk's summary, computing and caching it from a decode when
+        the seal didn't provide one (snapshot-recovered chunks).  The scan is
+        left-to-right, the same association the encoder's running sum uses."""
+        if self.summary is None:
+            self.summary = summarize_values(self.arrays()[1])
+        return self.summary
 
     def nbytes(self) -> int:
         """Retained payload bytes: both blobs plus 8 per tracked origin."""
@@ -260,6 +324,33 @@ class GorillaChunk:
     def arrays(self) -> tuple[np.ndarray, np.ndarray]:
         """Decode (uncached) into parallel (timestamps, values) arrays."""
         return decode(self.ts_blob, self.val_blob, self.count, self.ts_mode)
+
+
+def summarize_values(values) -> tuple:
+    """``(count, sum, min, max, nan_count)`` over an iterable of float64s,
+    skipping NaN staleness markers, accumulated strictly left-to-right —
+    the single definition of chunk-aggregate semantics shared by the
+    encoder's running summary, snapshot-recovered chunks, and the naive
+    reference path the planner is differential-tested against."""
+    n = 0
+    total = 0.0
+    vmin = math.inf
+    vmax = -math.inf
+    nans = 0
+    seq = values.tolist() if hasattr(values, "tolist") else values
+    for v in seq:
+        if v != v:
+            nans += 1
+        else:
+            n += 1
+            total += v
+            if v < vmin:
+                vmin = v
+            if v > vmax:
+                vmax = v
+    if n == 0:
+        return (0, 0.0, None, None, nans)
+    return (n, total, vmin, vmax, nans)
 
 
 def decode_ts(ts_blob: bytes, count: int, ts_mode: int) -> np.ndarray:

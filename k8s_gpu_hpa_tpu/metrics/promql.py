@@ -1,0 +1,439 @@
+"""PromQL-subset front-end: compile manifest rule strings into ``Expr`` ASTs.
+
+Until this module, recording/alert rules existed twice — ``Expr`` ASTs in
+``metrics/rules.py`` (what the closed-loop tests evaluate) and the PromQL
+strings ``tools/gen_prometheusrule.py`` renders from them (what the shipped
+Prometheus evaluates).  The renderer kept the two from drifting in one
+direction only; nothing proved the strings *mean* what the ASTs mean.  This
+parser closes the loop: every generated string must compile back to an AST
+structurally equal (dataclass ``==``) to its source
+(``tools/lint_promql_parity.py``, wired into tier-1), and the planner
+(``metrics/planner.py``) consumes the same ASTs — so YAML, in-process
+evaluation, and planned execution all share one semantic definition.
+
+The grammar is exactly the subset the shipped manifests use, no more:
+
+    expr        := cmp ("and" "on" "(" ")" cmp)*          # AndOn, left-assoc
+    cmp         := additive (CMPOP NUMBER)?               # Cmp vs scalar
+    additive    := multiplicative ("-" multiplicative)*   # only 1 - x (burn)
+    multiplicative := primary (mul_join | "/" primary)*
+    mul_join    := "*" "on" "(" labels ")"
+                   "group_left" "(" labels? ")" primary   # MulOnGroupLeft
+    primary     := NUMBER | "(" expr ")" | selector
+                 | AGGOP ("by" "(" labels ")")? "(" expr ")"
+                 | "absent" "(" expr ")"
+                 | "histogram_quantile" "(" NUMBER "," selector ")"
+                 | ("increase" | "avg_over_time") "(" selector range ")"
+    selector    := NAME ("{" NAME "=" STRING ("," NAME "=" STRING)* "}")?
+    range       := "[" DURATION "]"
+
+Aggregations canonicalize to the exact node the rule factories build —
+``avg(x)`` → :class:`Avg`, ``max by(...)`` → :class:`MaxBy`, bare
+``min/max/sum/count`` → :class:`Aggregate`, other grouped ops →
+:class:`AggregateBy` — and the SLO burn idiom
+``(1 - (increase(good[w]) / increase(total[w]))) / budget`` folds into one
+:class:`BurnRate` (objective ``1 - budget``; exact for the shipped budgets:
+``1 - 0.05 == 0.95`` and ``1 - 0.01 == 0.99`` are bit-true in IEEE double).
+A parenthesized division of two vector expressions is the federation
+:class:`Ratio`.  Anything outside the subset raises :class:`PromQLError`
+with the offending position — a parser that silently guessed would turn the
+parity lint into noise.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    Absent,
+    Aggregate,
+    AggregateBy,
+    AndOn,
+    Avg,
+    AvgOverTime,
+    BurnRate,
+    Cmp,
+    Expr,
+    HistogramQuantile,
+    MaxBy,
+    MulOnGroupLeft,
+    Ratio,
+    Select,
+)
+
+
+class PromQLError(ValueError):
+    """The input is outside the supported PromQL subset (or malformed)."""
+
+
+#: aggregation keywords and whether the bare (no ``by``) form has a
+#: dedicated node (``avg`` → Avg; the rest → Aggregate)
+_AGG_OPS = ("avg", "sum", "count", "min", "max")
+_CMP_OPS = ("==", "!=", "<=", ">=", "<", ">")
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<WS>\s+)
+  | (?P<DURATION>\d+(?:\.\d+)?[smhdwy])(?![A-Za-z0-9_:])
+  | (?P<NUMBER>\d+(?:\.\d+)?)
+  | (?P<NAME>[A-Za-z_:][A-Za-z0-9_:]*)
+  | (?P<STRING>"(?:[^"\\]|\\.)*")
+  | (?P<OP>==|!=|<=|>=|[<>{}()\[\],=*/+-])
+    """,
+    re.VERBOSE,
+)
+
+_DURATION_UNITS = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0,
+                   "w": 604800.0, "y": 31536000.0}
+
+
+def parse_duration(text: str) -> float:
+    """``5m`` → 300.0 — the inverse of ``rules._fmt_window``."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([smhdwy])", text)
+    if m is None:
+        raise PromQLError(f"bad duration {text!r}")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+@dataclass
+class _Token:
+    kind: str  # DURATION | NUMBER | NAME | STRING | OP | EOF
+    text: str
+    pos: int
+
+
+def tokenize(text: str) -> list[_Token]:
+    tokens: list[_Token] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise PromQLError(f"unexpected character {text[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind != "WS":
+            tokens.append(_Token(kind, m.group(), m.start()))
+    tokens.append(_Token("EOF", "", len(text)))
+    return tokens
+
+
+# -- intermediate forms -------------------------------------------------------
+# These exist only between parse and canonicalization: scalar literals, the
+# counter-delta halves of the burn idiom, and their quotient.  A finished
+# parse must be a pure Expr; an intermediate escaping to the top level means
+# the input used arithmetic the subset does not model.
+
+
+@dataclass
+class _Num:
+    value: float
+
+
+@dataclass
+class _Increase:
+    name: str
+    matchers: dict[str, str]
+    window: float
+
+
+@dataclass
+class _Div:
+    left: _Increase
+    right: _Increase
+
+
+@dataclass
+class _OneMinus:
+    inner: _Div
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.tokens = tokenize(text)
+        self.i = 0
+
+    # -- token plumbing ------------------------------------------------------
+
+    def peek(self) -> _Token:
+        return self.tokens[self.i]
+
+    def next(self) -> _Token:
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def expect(self, kind: str, text: str | None = None) -> _Token:
+        tok = self.next()
+        if tok.kind != kind or (text is not None and tok.text != text):
+            want = text if text is not None else kind
+            raise PromQLError(
+                f"expected {want!r} at {tok.pos}, got {tok.text!r} "
+                f"in {self.text!r}"
+            )
+        return tok
+
+    def at_op(self, *texts: str) -> bool:
+        tok = self.peek()
+        return tok.kind == "OP" and tok.text in texts
+
+    def at_name(self, *texts: str) -> bool:
+        tok = self.peek()
+        return tok.kind == "NAME" and tok.text in texts
+
+    # -- grammar -------------------------------------------------------------
+
+    def parse(self) -> Expr:
+        expr = self.parse_and()
+        tok = self.peek()
+        if tok.kind != "EOF":
+            raise PromQLError(
+                f"trailing input at {tok.pos}: {self.text[tok.pos:]!r}"
+            )
+        if not isinstance(expr, Expr):
+            raise PromQLError(
+                f"expression is not a vector query in the supported subset: "
+                f"{self.text!r}"
+            )
+        return expr
+
+    def parse_and(self):
+        left = self.parse_cmp()
+        while self.at_name("and"):
+            self.next()
+            self.expect("NAME", "on")
+            self.expect("OP", "(")
+            if not self.at_op(")"):
+                tok = self.peek()
+                raise PromQLError(
+                    f"only the empty match group 'and on()' is supported "
+                    f"(got labels at {tok.pos})"
+                )
+            self.expect("OP", ")")
+            right = self.parse_cmp()
+            if not isinstance(left, Expr) or not isinstance(right, Expr):
+                raise PromQLError("'and on()' operands must be vector queries")
+            left = AndOn(left, right)
+        return left
+
+    def parse_cmp(self):
+        left = self.parse_additive()
+        if self.at_op(*_CMP_OPS):
+            op = self.next().text
+            tok = self.peek()
+            if tok.kind != "NUMBER":
+                raise PromQLError(
+                    f"comparison threshold must be a scalar literal at "
+                    f"{tok.pos} (got {tok.text!r})"
+                )
+            threshold = float(self.next().text)
+            if not isinstance(left, Expr):
+                raise PromQLError("comparison operand must be a vector query")
+            return Cmp(left, op, threshold)
+        return left
+
+    def parse_additive(self):
+        left = self.parse_mul()
+        while self.at_op("-", "+"):
+            op = self.next().text
+            right = self.parse_mul()
+            if (
+                op == "-"
+                and isinstance(left, _Num)
+                and left.value == 1.0
+                and isinstance(right, _Div)
+            ):
+                left = _OneMinus(right)
+            else:
+                raise PromQLError(
+                    "arithmetic outside '1 - (increase(...) / increase(...))' "
+                    f"is not in the supported subset: {self.text!r}"
+                )
+        return left
+
+    def parse_mul(self):
+        left = self.parse_primary()
+        while self.at_op("*", "/"):
+            op = self.next().text
+            if op == "*":
+                left = self.parse_join_tail(left)
+                continue
+            right = self.parse_primary()
+            left = self.fold_div(left, right)
+        return left
+
+    def parse_join_tail(self, left):
+        """``* on(k,...) group_left(extra...) right`` — the app-scoping join."""
+        if not self.at_name("on"):
+            raise PromQLError(
+                "bare '*' is not supported; only "
+                "'* on(...) group_left(...)' joins"
+            )
+        self.next()
+        self.expect("OP", "(")
+        on = self.parse_label_list()
+        self.expect("OP", ")")
+        self.expect("NAME", "group_left")
+        self.expect("OP", "(")
+        group_left = self.parse_label_list()
+        self.expect("OP", ")")
+        right = self.parse_primary()
+        if not isinstance(left, Expr) or not isinstance(right, Expr):
+            raise PromQLError("join operands must be vector queries")
+        return MulOnGroupLeft(left, right, on=on, group_left=group_left)
+
+    def fold_div(self, left, right):
+        """Canonicalize a quotient: burn rate, federation ratio, or the
+        increase/increase intermediate inside the burn parentheses."""
+        if isinstance(left, _Increase) and isinstance(right, _Increase):
+            return _Div(left, right)
+        if isinstance(left, _OneMinus) and isinstance(right, _Num):
+            good, total = left.inner.left, left.inner.right
+            if good.window != total.window:
+                raise PromQLError(
+                    f"burn-rate windows disagree: {good.window} vs "
+                    f"{total.window}"
+                )
+            return BurnRate(
+                good_name=good.name,
+                total_name=total.name,
+                objective=1.0 - right.value,
+                window=float(good.window),
+                good_matchers=good.matchers,
+                total_matchers=total.matchers,
+            )
+        if isinstance(left, Expr) and isinstance(right, Expr):
+            return Ratio(left, right)
+        raise PromQLError(
+            f"unsupported division operands in {self.text!r}"
+        )
+
+    def parse_primary(self):
+        tok = self.peek()
+        if tok.kind == "NUMBER":
+            return _Num(float(self.next().text))
+        if self.at_op("("):
+            self.next()
+            inner = self.parse_and()
+            self.expect("OP", ")")
+            return inner
+        if tok.kind != "NAME":
+            raise PromQLError(
+                f"expected expression at {tok.pos}, got {tok.text!r} "
+                f"in {self.text!r}"
+            )
+        name = tok.text
+        if name in _AGG_OPS and self.is_aggregation_call():
+            return self.parse_aggregation()
+        if name == "absent":
+            self.next()
+            self.expect("OP", "(")
+            child = self.parse_and()
+            self.expect("OP", ")")
+            if not isinstance(child, Expr):
+                raise PromQLError("absent() takes a vector query")
+            return Absent(child)
+        if name == "histogram_quantile":
+            return self.parse_histogram_quantile()
+        if name in ("increase", "avg_over_time"):
+            return self.parse_range_fn(name)
+        return self.parse_selector()
+
+    def is_aggregation_call(self):
+        """Disambiguate ``max(...)`` / ``max by(...)`` from a selector whose
+        metric happens to be named ``max`` (legal PromQL, absent from our
+        manifests but cheap to keep correct)."""
+        nxt = self.tokens[self.i + 1]
+        return (nxt.kind == "OP" and nxt.text == "(") or (
+            nxt.kind == "NAME" and nxt.text == "by"
+        )
+
+    def parse_aggregation(self):
+        op = self.next().text
+        keys: tuple[str, ...] | None = None
+        if self.at_name("by"):
+            self.next()
+            self.expect("OP", "(")
+            keys = self.parse_label_list()
+            self.expect("OP", ")")
+        self.expect("OP", "(")
+        child = self.parse_and()
+        self.expect("OP", ")")
+        if not isinstance(child, Expr):
+            raise PromQLError(f"{op}() takes a vector query")
+        if keys is None:
+            return Avg(child) if op == "avg" else Aggregate(op, child)
+        if op == "max":
+            return MaxBy(keys, child)
+        return AggregateBy(op, keys, child)
+
+    def parse_histogram_quantile(self):
+        self.next()
+        self.expect("OP", "(")
+        q_tok = self.expect("NUMBER")
+        self.expect("OP", ",")
+        sel = self.parse_selector()
+        self.expect("OP", ")")
+        if not sel.name.endswith("_bucket"):
+            raise PromQLError(
+                f"histogram_quantile() needs a _bucket selector, got "
+                f"{sel.name!r}"
+            )
+        return HistogramQuantile(
+            float(q_tok.text), sel.name[: -len("_bucket")], sel.matchers
+        )
+
+    def parse_range_fn(self, fn: str):
+        self.next()
+        self.expect("OP", "(")
+        sel = self.parse_selector()
+        self.expect("OP", "[")
+        window = parse_duration(self.expect("DURATION").text)
+        self.expect("OP", "]")
+        self.expect("OP", ")")
+        if fn == "avg_over_time":
+            return AvgOverTime(sel.name, window, sel.matchers)
+        return _Increase(sel.name, sel.matchers, window)
+
+    def parse_selector(self) -> Select:
+        name = self.expect("NAME").text
+        matchers: dict[str, str] = {}
+        if self.at_op("{"):
+            self.next()
+            while not self.at_op("}"):
+                key = self.expect("NAME").text
+                self.expect("OP", "=")
+                raw = self.expect("STRING").text
+                matchers[key] = (
+                    raw[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+                )
+                if self.at_op(","):
+                    self.next()
+                elif not self.at_op("}"):
+                    tok = self.peek()
+                    raise PromQLError(
+                        f"expected ',' or '}}' in matchers at {tok.pos}"
+                    )
+            self.expect("OP", "}")
+        return Select(name, matchers)
+
+    def parse_label_list(self) -> tuple[str, ...]:
+        labels: list[str] = []
+        while self.peek().kind == "NAME":
+            labels.append(self.next().text)
+            if self.at_op(","):
+                self.next()
+            else:
+                break
+        return tuple(labels)
+
+
+def parse(text: str) -> Expr:
+    """Compile one PromQL string into the ``Expr`` AST it denotes.
+
+    Round-trip contract (the parity lint): for every expression ``e`` a rule
+    factory builds, ``parse(e.promql()) == e`` (dataclass structural
+    equality), and for every string ``s`` in a generated manifest,
+    ``parse(s).promql() == s``."""
+    return _Parser(text).parse()

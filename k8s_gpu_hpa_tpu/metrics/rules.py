@@ -191,6 +191,43 @@ class Aggregate(Expr):
 
 
 @dataclass
+class AggregateBy(Expr):
+    """``sum by(k1,...)(child)`` / ``count by(...)`` / ``min``/``avg`` —
+    grouped aggregation keeping the projected label set (``max by`` keeps its
+    dedicated :class:`MaxBy` node for rendering parity with the shipped
+    rules; the parser canonicalizes ``max by`` to MaxBy, never to this)."""
+
+    op: str  # "sum" | "count" | "min" | "avg"
+    keys: tuple[str, ...]
+    child: Expr
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        groups: dict[tuple[tuple[str, str], ...], list[float]] = {}
+        for sample in self.child.evaluate(db, at):
+            groups.setdefault(_project(sample, self.keys), []).append(sample.value)
+        out: Vector = []
+        for key, values in groups.items():
+            if self.op == "sum":
+                value = sum(values)
+            elif self.op == "count":
+                value = float(len(values))
+            elif self.op == "min":
+                value = min(values)
+            elif self.op == "avg":
+                value = sum(values) / len(values)
+            else:
+                raise ValueError(f"unsupported grouped aggregation {self.op!r}")
+            out.append(Sample(value, key))
+        return out
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
+    def promql(self) -> str:
+        return f"{self.op} by({','.join(self.keys)})({self.child.promql()})"
+
+
+@dataclass
 class Ratio(Expr):
     """``left / right`` over two scalar-producing expressions — the
     federation-aggregate idiom: a global average computed as
@@ -339,8 +376,13 @@ class HistogramQuantile(Expr):
     matchers: dict[str, str] = field(default_factory=dict)
 
     def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        return self._group(db.instant_vector(self.name + "_bucket", self.matchers, at))
+
+    def _group(self, bucket_samples: Vector) -> Vector:
+        """Shared grouping/interpolation over the bucket vector — the planned
+        path (planner._PlannedHistogramQuantile) feeds it a planned scan."""
         groups: dict[tuple[tuple[str, str], ...], list[tuple[float, float]]] = {}
-        for sample in db.instant_vector(self.name + "_bucket", self.matchers, at):
+        for sample in bucket_samples:
             le = None
             rest: list[tuple[str, str]] = []
             for k, v in sample.labels:
@@ -380,6 +422,32 @@ def _fmt_window(seconds: float) -> str:
     if s % 60 == 0:
         return f"{s // 60}m"
     return f"{s}s"
+
+
+@dataclass
+class AvgOverTime(Expr):
+    """``avg_over_time(name{matchers}[window])`` — per-series mean over the
+    trailing window, NaN staleness markers excluded (they are not samples).
+
+    Evaluation delegates to :meth:`TimeSeriesDB.range_avg`, the one windowed
+    read both execution paths share: this naive node decodes every touched
+    chunk; the planner calls the same method with summary pushdown enabled,
+    and the shared per-segment accumulation shape keeps the two bit-identical
+    (tests/test_promql.py's differential property test)."""
+
+    name: str
+    window: float  # seconds
+    matchers: dict[str, str] = field(default_factory=dict)
+
+    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> Vector:
+        return db.range_avg(self.name, self.matchers, self.window, at)
+
+    def input_names(self) -> frozenset[str]:
+        return frozenset((self.name,))
+
+    def promql(self) -> str:
+        inner = Select(self.name, dict(self.matchers))
+        return f"avg_over_time({inner.promql()}[{_fmt_window(self.window)}])"
 
 
 @dataclass
@@ -459,9 +527,11 @@ class AlertRule:
     _pending_since: float | None = field(default=None, repr=False)
     firing: bool = field(default=False, repr=False)
 
-    def evaluate(self, db: TimeSeriesDB, at: float | None = None) -> bool:
+    def evaluate(
+        self, db: TimeSeriesDB, at: float | None = None, plan: Expr | None = None
+    ) -> bool:
         now = db.clock.now() if at is None else at
-        if not self.expr.evaluate(db, at):
+        if not (self.expr if plan is None else plan).evaluate(db, at):
             self._pending_since = None
             self.firing = False
             return False
@@ -530,6 +600,7 @@ class RecordingRule:
         at: float | None = None,
         tracer=None,
         selfmetrics=None,
+        plan: Expr | None = None,
     ) -> int:
         """Evaluate and write the result series back into the TSDB.  Output
         series that stop being produced get staleness markers (Prometheus rule
@@ -569,7 +640,7 @@ class RecordingRule:
         # aging guard above (and lineage/self-metrics when wired)
         db.begin_capture()
         try:
-            outputs = self.expr.evaluate(db, at)
+            outputs = (self.expr if plan is None else plan).evaluate(db, at)
         finally:
             reads = db.end_capture()
         produced: set[tuple[tuple[str, str], ...]] = set()
@@ -626,6 +697,7 @@ class RuleEvaluator:
         alerts: list[AlertRule] | None = None,
         tracer=None,
         selfmetrics=None,
+        planner=None,
     ):
         self.db = db
         self.rules = rules
@@ -635,16 +707,39 @@ class RuleEvaluator:
         #: rule evaluation (rule_eval spans + staleness gauges)
         self.tracer = tracer
         self.selfmetrics = selfmetrics
+        #: planner.QueryPlanner, or None for naive evaluation; with one,
+        #: every rule/alert expression runs its cached physical plan (the
+        #: version-signature skip and read-capture lineage are unchanged —
+        #: both live here/in the DB, outside the expression walk)
+        self.planner = planner
 
     def evaluate_once(self) -> int:
-        count = sum(
-            rule.evaluate_into(
-                self.db, tracer=self.tracer, selfmetrics=self.selfmetrics
-            )
-            for rule in self.rules
-        )
+        planner = self.planner
+
+        def plan_for(rule):
+            # rules without an expression AST (obs.slo.SLORecorder folds
+            # counters imperatively) have nothing to plan
+            expr = getattr(rule, "expr", None)
+            if planner is None or expr is None:
+                return None
+            return planner.plan(expr)
+
+        count = 0
+        for rule in self.rules:
+            plan = plan_for(rule)
+            if plan is None:
+                count += rule.evaluate_into(
+                    self.db, tracer=self.tracer, selfmetrics=self.selfmetrics
+                )
+            else:
+                count += rule.evaluate_into(
+                    self.db,
+                    tracer=self.tracer,
+                    selfmetrics=self.selfmetrics,
+                    plan=plan,
+                )
         for alert in self.alerts:
-            alert.evaluate(self.db)
+            alert.evaluate(self.db, plan=plan_for(alert))
         return count
 
     def firing_alerts(self) -> list[str]:

@@ -150,6 +150,7 @@ class _Series:
                 self.last_ts,
                 None if origins is None else tuple(origins),
                 enc.ts_mode,
+                enc.summary(),
             )
         )
         enc.reset()
@@ -327,6 +328,15 @@ class TimeSeriesDB:
         #: chunks currently holding a decoded cache, eviction order (each
         #: chunk appears at most once: it joins on decode, leaves on evict)
         self._decoded_chunks: deque[GorillaChunk] = deque()
+        #: decoded-window cache traffic (the planner/self-metrics surface:
+        #: a hit serves a sealed chunk's columns without a Gorilla decode)
+        self.decode_cache_hits = 0
+        self.decode_cache_misses = 0
+        #: per-name series-SET generation: bumped only when a series is
+        #: created or GC-dropped for that name — the planner's cheap validity
+        #: check for a resolved series set (unlike ``_versions``, which
+        #: bumps on every append)
+        self._series_gen: dict[str, int] = {}
         self._total_points = 0
         self._appends_since_gc = 0
         #: active read-capture sink (see begin_capture), else None
@@ -363,6 +373,7 @@ class TimeSeriesDB:
             index = self._index.setdefault(name, {})
             for pair in labels:
                 index.setdefault(pair, {})[labels] = None
+            self._series_gen[name] = self._series_gen.get(name, 0) + 1
         elif ts < series.last_ts:
             # Out-of-order appends would silently break the sorted-columns/
             # scan-from-end invariant every read relies on; reject loudly.
@@ -452,6 +463,7 @@ class TimeSeriesDB:
                     del self._index[name]
             if not by_name:
                 del self._data[name]
+            self._series_gen[name] = self._series_gen.get(name, 0) + 1
             dropped += 1
         return dropped
 
@@ -705,6 +717,36 @@ class TimeSeriesDB:
         captured, self._capture = self._capture or [], None
         return captured
 
+    def series_for(
+        self, name: str, matchers: dict[str, str] | None = None
+    ) -> list:
+        """Resolve the matching ``_Series`` set via the inverted index —
+        the label-matcher pushdown the planner caches per plan, validated
+        against :meth:`series_generation` (instant_vector inlines the same
+        resolution on its own hot path)."""
+        by_name = self._data.get(name)
+        if not by_name:
+            return []
+        if not matchers:
+            return list(by_name.values())
+        index = self._index.get(name, {})
+        buckets: list[dict[LabelSet, None]] = []
+        for pair in matchers.items():
+            bucket = index.get(pair)
+            if not bucket:
+                return []
+            buckets.append(bucket)
+        buckets.sort(key=len)
+        smallest, rest = buckets[0], buckets[1:]
+        if rest:
+            return [by_name[ls] for ls in smallest if all(ls in b for b in rest)]
+        return [by_name[ls] for ls in smallest]
+
+    def series_generation(self, name: str) -> int:
+        """Monotonic counter bumped when a series of ``name`` is created or
+        dropped (NOT on appends): the planner's series-set cache validator."""
+        return self._series_gen.get(name, 0)
+
     def instant_vector(
         self,
         name: str,
@@ -765,6 +807,135 @@ class TimeSeriesDB:
             out.append(Sample(value, series.labels))
         return out
 
+    def range_avg(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        window_s: float = 0.0,
+        at: float | None = None,
+        use_summaries: bool = False,
+        stats=None,
+    ) -> list[Sample]:
+        """``avg_over_time(name{matchers}[window])``: per-series mean over
+        points in ``[at - window_s, at]``, NaN staleness markers excluded
+        (range-vector semantics: markers are not samples, and lookback does
+        not apply).
+
+        Both execution paths produce **bit-identical** floats by sharing one
+        accumulation shape: each segment (sealed chunk, then head) reduces to
+        a left-to-right subtotal over its in-window slice, and subtotals fold
+        into the running sum in segment order.  With ``use_summaries`` a chunk
+        fully inside the window contributes its seal-time summary — the same
+        left-to-right sum its decode-scan would produce — without touching
+        the Gorilla blobs (``stats.fastpath``); partial chunks and the head
+        decode as usual (``stats.fallback``).
+
+        Capture records the newest in-window non-NaN point per contributing
+        series (the provenance hop lineage walks), identically on both paths.
+        """
+        at = self.clock.now() if at is None else at
+        start = at - window_s
+        capture = self._capture
+        chunk_arrays = self._chunk_arrays
+        out: list[Sample] = []
+        for series in self.series_for(name, matchers):
+            n = 0
+            total = 0.0
+            for chunk in series.chunks:
+                if chunk.last_ts < start or chunk.first_ts > at:
+                    continue
+                if use_summaries and chunk.first_ts >= start:
+                    # sorted columns: last_ts <= at is implied unless the
+                    # query cuts mid-chunk, checked explicitly
+                    if chunk.last_ts <= at:
+                        sc, ssum = chunk.ensure_summary()[:2]
+                        if stats is not None:
+                            stats.fastpath += 1
+                        if sc:
+                            n += sc
+                            total += ssum
+                        continue
+                if stats is not None:
+                    stats.fallback += 1
+                ts_arr, val_arr = chunk_arrays(chunk)
+                lo = int(ts_arr.searchsorted(start, side="left"))
+                hi = int(ts_arr.searchsorted(at, side="right"))
+                sub_n = 0
+                sub = 0.0
+                for v in val_arr[lo:hi].tolist():
+                    if v == v:
+                        sub_n += 1
+                        sub += v
+                if sub_n:
+                    n += sub_n
+                    total += sub
+            if (
+                series.enc.count
+                and series.last_ts >= start
+                and series.head_first_ts <= at
+            ):
+                ts_arr, val_arr = series.head_arrays()
+                lo = int(ts_arr.searchsorted(start, side="left"))
+                hi = int(ts_arr.searchsorted(at, side="right"))
+                sub_n = 0
+                sub = 0.0
+                for v in val_arr[lo:hi].tolist():
+                    if v == v:
+                        sub_n += 1
+                        sub += v
+                if sub_n:
+                    n += sub_n
+                    total += sub
+            if n == 0:
+                continue
+            if capture is not None:
+                point = self._newest_in_window(series, start, at)
+                if point is not None:
+                    capture.append(
+                        (name, series.labels, point[0], point[1], point[2])
+                    )
+            out.append(Sample(total / n, series.labels))
+        return out
+
+    def _newest_in_window(
+        self, series: _Series, start: float, at: float
+    ) -> tuple[float, float, int | None] | None:
+        """Newest non-NaN point with ``start <= ts <= at`` — the capture
+        representative of a range read (head first, then chunks newest-first)."""
+        if series.enc.count and series.head_first_ts <= at:
+            ts_arr, val_arr = series.head_arrays()
+            hi = int(ts_arr.searchsorted(at, side="right"))
+            for i in range(hi - 1, -1, -1):
+                if float(ts_arr[i]) < start:
+                    break
+                v = float(val_arr[i])
+                if v == v:
+                    origins = series.head_origins
+                    return (
+                        float(ts_arr[i]),
+                        v,
+                        None if origins is None else origins[i],
+                    )
+        for chunk in reversed(series.chunks):
+            if chunk.first_ts > at:
+                continue
+            if chunk.last_ts < start:
+                break
+            ts_arr, val_arr = self._chunk_arrays(chunk)
+            hi = int(ts_arr.searchsorted(at, side="right"))
+            for i in range(hi - 1, -1, -1):
+                if float(ts_arr[i]) < start:
+                    break
+                v = float(val_arr[i])
+                if v == v:
+                    origins = chunk.origins
+                    return (
+                        float(ts_arr[i]),
+                        v,
+                        None if origins is None else origins[i],
+                    )
+        return None
+
     def _chunk_arrays(self, chunk: GorillaChunk):
         """Decoded (ts, values) arrays of a sealed chunk, cached on the
         chunk itself; at most ``DECODE_CACHE_CHUNKS`` caches stay live (a
@@ -772,11 +943,14 @@ class TimeSeriesDB:
         membership is unique by construction)."""
         arrs = chunk._decoded
         if arrs is None:
+            self.decode_cache_misses += 1
             arrs = chunk._decoded = chunk.arrays()
             cache = self._decoded_chunks
             cache.append(chunk)
             if len(cache) > self.DECODE_CACHE_CHUNKS:
                 cache.popleft()._decoded = None
+        else:
+            self.decode_cache_hits += 1
         return arrs
 
     def latest(self, name: str, matchers: dict[str, str] | None = None) -> float | None:

@@ -39,11 +39,38 @@ RULE_EVAL_STALENESS = "rule_eval_staleness_seconds"
 #: HPA sync decisions by outcome (counter)
 HPA_DECISION_TOTAL = "hpa_decision_total"
 
+# Query-engine counters (ISSUE 7): how rule evaluation is actually being
+# served.  fastpath/fallback count chunks on planned range reads — served
+# from the seal-time summary without a Gorilla decode vs decoded (window
+# boundary or head).  The series counters split per-eval series-set
+# validations into revalidated-from-cache vs re-resolved through the
+# inverted index.  The decode-cache pair counts sealed-chunk column reads
+# served from the TSDB's decoded-window cache vs decoded fresh.
+
+#: chunks served from seal-time summaries on planned range reads (counter)
+PLANNER_FASTPATH_TOTAL = "query_planner_fastpath_chunks_total"
+#: chunks a planned range read had to decode (counter)
+PLANNER_FALLBACK_TOTAL = "query_planner_fallback_chunks_total"
+#: series sets revalidated from the plan cache (counter)
+PLANNER_SERIES_CACHE_HITS = "query_planner_series_cache_hits_total"
+#: series sets re-resolved through the inverted index (counter)
+PLANNER_SERIES_RESOLVES = "query_planner_series_resolves_total"
+#: sealed-chunk column reads served from the decoded-window cache (counter)
+DECODE_CACHE_HITS = "tsdb_decode_cache_hits_total"
+#: sealed-chunk column reads that decoded Gorilla blobs (counter)
+DECODE_CACHE_MISSES = "tsdb_decode_cache_misses_total"
+
 SELF_METRIC_NAMES = (
     HPA_SYNC_DURATION,
     SCRAPE_DURATION,
     RULE_EVAL_STALENESS,
     HPA_DECISION_TOTAL,
+    PLANNER_FASTPATH_TOTAL,
+    PLANNER_FALLBACK_TOTAL,
+    PLANNER_SERIES_CACHE_HITS,
+    PLANNER_SERIES_RESOLVES,
+    DECODE_CACHE_HITS,
+    DECODE_CACHE_MISSES,
 )
 
 # ---- distribution self-metrics (histograms with trace exemplars) -----------
@@ -147,6 +174,18 @@ class PipelineSelfMetrics:
             "workload change to scale event, virtual seconds",
             bounds=SIGNAL_PROPAGATION_BUCKETS,
         )
+        #: (PlannerStats, db) once attach_query_engine is called; counters
+        #: are read at exposition time, not pushed — they already live on
+        #: the planner/TSDB, and a push hook would double-count
+        self._planner_stats = None
+        self._query_db = None
+
+    def attach_query_engine(self, planner_stats, db) -> None:
+        """Wire the query-engine counter sources (the pipeline calls this
+        when it builds its QueryPlanner, and again after restart_tsdb swaps
+        the DB out from under the exposition)."""
+        self._planner_stats = planner_stats
+        self._query_db = db
 
     def histograms(self) -> tuple[Histogram, ...]:
         return (
@@ -230,5 +269,48 @@ class PipelineSelfMetrics:
         for reason, count in sorted(self.decisions.items()):
             decisions.add(float(count), reason=reason)
         families = [sync, scrape, staleness, decisions]
+        if self._planner_stats is not None:
+            s = self._planner_stats
+            for name, help_text, value in (
+                (
+                    PLANNER_FASTPATH_TOTAL,
+                    "chunks served from seal-time summaries without decode",
+                    s.fastpath,
+                ),
+                (
+                    PLANNER_FALLBACK_TOTAL,
+                    "chunks a planned range read decoded",
+                    s.fallback,
+                ),
+                (
+                    PLANNER_SERIES_CACHE_HITS,
+                    "series sets revalidated from the plan cache",
+                    s.series_cache_hits,
+                ),
+                (
+                    PLANNER_SERIES_RESOLVES,
+                    "series sets re-resolved through the inverted index",
+                    s.series_resolves,
+                ),
+            ):
+                fam = MetricFamily(name, "counter", help_text)
+                fam.add(float(value))
+                families.append(fam)
+        if self._query_db is not None:
+            for name, help_text, value in (
+                (
+                    DECODE_CACHE_HITS,
+                    "sealed-chunk reads served from the decoded-window cache",
+                    self._query_db.decode_cache_hits,
+                ),
+                (
+                    DECODE_CACHE_MISSES,
+                    "sealed-chunk reads that decoded Gorilla blobs",
+                    self._query_db.decode_cache_misses,
+                ),
+            ):
+                fam = MetricFamily(name, "counter", help_text)
+                fam.add(float(value))
+                families.append(fam)
         families.extend(h.family() for h in self.histograms())
         return encode_text(families)

@@ -64,6 +64,29 @@ SIM_SCALE_10K_SMOKE_SHARDS = 4
 #: measured ~550 on a dev box
 SIM_SCALE_10K_SMOKE_MIN_SPEEDUP = 50.0
 
+# ---- query_bench: planned vs naive rule evaluation (ISSUE 7) ----------------
+
+#: the full rung runs the fleet-aggregate rule basket at the sim_scale_10k
+#: population (10k fleet series across 8 shard DBs), with enough history
+#: that most sealed chunks sit fully inside the range window — the shape
+#: the chunk-summary pushdown exists for
+QUERY_BENCH_TARGETS = SIM_SCALE_10K_TARGETS
+QUERY_BENCH_SHARDS = SIM_SCALE_10K_SHARDS
+QUERY_BENCH_HORIZON_S = 3600.0
+QUERY_BENCH_INTERVAL_S = 5.0
+#: range-rule window; starts mid-chunk so boundary decode stays exercised
+QUERY_BENCH_WINDOW_S = 3300.0
+#: planned-vs-naive wall-time floor for the basket (measured ~9-10x; the
+#: pushdown collapsing would land near 1x, nowhere near the gate)
+MIN_PLANNED_SPEEDUP = 3.0
+
+QUERY_BENCH_SMOKE_TARGETS = 500
+QUERY_BENCH_SMOKE_SHARDS = 4
+QUERY_BENCH_SMOKE_HORIZON_S = 1800.0
+#: smoke keeps fewer sealed chunks per series, so the decode-avoidance
+#: margin is structurally smaller than the full rung's
+QUERY_BENCH_SMOKE_MIN_PLANNED_SPEEDUP = 2.0
+
 #: Gorilla columns must stay >= 4x denser than the 16-byte uncompressed
 #: point (measured 4.7-5.2x on the synthetic fleet; a silent fall-back to
 #: raw encoding or an origins-column leak lands well under 4)

@@ -82,6 +82,11 @@ class SimReport:
     #: scenario-relative clock offset: span timestamps minus this value are
     #: on the timeline's t axis (the 15 s settle precedes the scenario)
     trace_base: float = 0.0
+    #: query-engine counters from the run's planner + TSDB decode cache
+    #: (metrics/planner.py) — printed by the trace scenario
+    query_engine: dict | None = None
+    #: rendered physical plans for the pipeline's rules (``--explain``)
+    plan_explain: str | None = None
 
 
 def run_scenario(
@@ -93,6 +98,7 @@ def run_scenario(
     saturated_pct: float | None = None,
     trace: bool = False,
     shards: int = 0,
+    explain: bool = False,
 ) -> SimReport:
     """Simulate one shipped Object-metric HPA manifest under a load scenario.
 
@@ -244,6 +250,32 @@ def run_scenario(
             report.scale_up_latency = elapsed - t_cross
 
     report.scale_events = [(ts - base, a, b) for ts, a, b in pipe.scale_history]
+    stats = pipe.planner.stats
+    report.query_engine = {
+        "fastpath_chunks": stats.fastpath,
+        "fallback_chunks": stats.fallback,
+        "series_cache_hits": stats.series_cache_hits,
+        "series_resolves": stats.series_resolves,
+        "plans_built": stats.plans_built,
+        "decode_cache_hits": pipe.db.decode_cache_hits,
+        "decode_cache_misses": pipe.db.decode_cache_misses,
+    }
+    if explain:
+        sections = []
+        for rule in pipe.evaluator.rules:
+            expr = getattr(rule, "expr", None)
+            if expr is None:
+                continue  # SLO recorders fold counters imperatively: no AST
+            sections.append(
+                f"{rule.record} = {expr.promql()}\n"
+                + pipe.planner.explain(expr)
+            )
+        for alert in pipe.evaluator.alerts or []:
+            sections.append(
+                f"ALERT {alert.alert} = {alert.expr.promql()}\n"
+                + pipe.planner.explain(alert.expr)
+            )
+        report.plan_explain = "\n\n".join(sections)
     return report
 
 
@@ -580,8 +612,24 @@ def main(args) -> int:
             pod_start_latency=args.pod_start,
             trace=True,
             shards=getattr(args, "shards", 0),
+            explain=getattr(args, "explain", False),
         )
         print(render_trace_timeline(report))
+        if report.plan_explain:
+            print()
+            print("physical plans (query planner):")
+            print(report.plan_explain)
+        qe = report.query_engine
+        print()
+        print(
+            "query engine: planner fastpath "
+            f"{qe['fastpath_chunks']} chunk(s) / fallback "
+            f"{qe['fallback_chunks']} decode(s); series cache "
+            f"{qe['series_cache_hits']} hit(s) / {qe['series_resolves']} "
+            f"resolve(s); decoded-window cache {qe['decode_cache_hits']} "
+            f"hit(s) / {qe['decode_cache_misses']} miss(es); "
+            f"{qe['plans_built']} plan(s) built"
+        )
         tracer = report.tracer
         prop = propagation_report(tracer.spans)
         print()
@@ -646,6 +694,7 @@ def main(args) -> int:
                 pod_start_latency=args.pod_start,
                 saturated_pct=getattr(args, "saturated_pct", None),
                 shards=getattr(args, "shards", 0),
+                explain=getattr(args, "explain", False),
             )
     except ValueError as e:
         # e.g. an External manifest with an Object-only scenario (outage,
@@ -653,6 +702,10 @@ def main(args) -> int:
         print(f"simulate: {e}")
         return 2
     print(render_report(report))
+    if report.plan_explain:
+        print()
+        print("physical plans (query planner):")
+        print(report.plan_explain)
     return 0
 
 
@@ -694,6 +747,12 @@ if __name__ == "__main__":
         default=0,
         help="run the scenario against a sharded scrape plane with N "
         "hash-ring scraper shards (0 = single scraper)",
+    )
+    parser.add_argument(
+        "--explain",
+        action="store_true",
+        help="print the query planner's physical plan for every rule and "
+        "alert the pipeline evaluates (see ARCHITECTURE.md: query engine)",
     )
     parser.add_argument(
         "--trace-out",

@@ -155,9 +155,22 @@ def test_time_budget_completes_unattended_with_labeled_skips():
     # rc 0 (all budgets met) or 2 (a budget failed — e.g. drain jitter on a
     # loaded host); both mean the bench COMPLETED and printed its record.
     assert bench.proc.returncode in (0, 2), f"rc={bench.proc.returncode}"
-    assert len(bench.lines) >= 2, "expected early contract line + final line"
+    assert len(bench.lines) >= 3, (
+        "expected early contract line + final full record + summary line"
+    )
     _assert_contract(bench.lines[0])
-    final = _assert_contract(bench.lines[-1])
+    # the very last line is the compact always-parseable summary: driver
+    # contract fields plus a per-rung status digest, never the full record
+    summary = json.loads(bench.lines[-1])
+    assert summary.get("summary") is True
+    for field in CONTRACT_FIELDS:
+        assert field in summary, f"summary line missing {field!r}"
+    assert summary["time_scale"] == 0.1
+    assert summary["mode"] == "cpu_fallback"
+    assert summary["rungs"].get("sim_scale") == "ok"
+    assert summary["rungs"].get("query_bench") == "ok"
+    # the line before it carries the full record
+    final = _assert_contract(bench.lines[-2])
     # the over-budget phases are labeled skips, not silent absences
     assert final["overshoot_skipped"] == "time budget"
     assert final["kernel"].get("skipped") == "time budget"
@@ -172,6 +185,13 @@ def test_time_budget_completes_unattended_with_labeled_skips():
     for key in ("speedup", "peak_retained_points", "query_p95_ms"):
         assert key in sim_scale, f"sim_scale rung missing {key!r}"
     assert sim_scale["meets_floor"] is True
+    # query_bench rung contract: planned execution must be bit-identical to
+    # naive AND faster, with genuine summary fast-path traffic
+    query_bench = final["rungs"]["query_bench"]
+    for key in ("speedup", "identical", "query_p95_ms", "planner_fastpath"):
+        assert key in query_bench, f"query_bench rung missing {key!r}"
+    assert query_bench["identical"] is True
+    assert query_bench["ok"] is True
     # recovery_drill rung contract: every bench run reports how long the
     # control plane was degraded (MTTR) and how much replayed state lagged
     # (replay gap) when its components are killed and rebuilt mid-run

@@ -234,6 +234,28 @@ def test_supervised_gen_late_return_does_not_mask_second_wedge():
         for g in gens:
             g._wedge.set()
 
+def test_query_bench_rung_gates_identity_speedup_and_fastpath(monkeypatch):
+    """The planner rung (ISSUE 7), exercised at smoke sizing (TIME_SCALE
+    != 1 path: 500 targets / 4 shards): planned execution must produce
+    bit-identical vectors, beat the naive walk by the smoke floor, keep the
+    fleet-query p95 inside the shared 3 ms budget, and actually take the
+    chunk-summary fast path (not silently decode everything)."""
+    monkeypatch.setattr(bench, "TIME_SCALE", 0.1)
+    result = bench.run_rung_query_bench()
+    assert result["mode"] == "virtual"
+    assert result["targets"] == 500 and result["shards"] == 4
+    assert result["identical"] is True
+    assert result["speedup"] >= result["speedup_floor"]
+    assert result["query_p95_ms"] <= result["query_p95_budget_ms"]
+    assert result["planner_fastpath"] > 0
+    # the boundary-decode path must be exercised too: the range window
+    # deliberately starts mid-chunk, so an all-fastpath run means the
+    # window/chunk layout drifted and the bench stopped testing decode
+    assert result["planner_fallback"] > 0
+    assert result["series_cache_hits"] > result["series_resolves"]
+    assert result["ok"] is True
+
+
 def test_sim_scale_10k_rung_gates_compression_query_and_ring(monkeypatch):
     """The sharded federation rung (ISSUE 6), exercised at smoke sizing
     (TIME_SCALE != 1 path: 2000 targets / 4 shards) so tier-1 stays fast —

@@ -150,8 +150,8 @@ def test_diagnose_stops_at_first_broken_joint():
 def test_diagnose_skips_absent_fetchers():
     results = diagnose(exporter_fetch=lambda: exposition())
     # L2 + L3 + L3 scrape health + L3 shard topology + L3 self-metrics
-    # + L3 histograms + L4 + L5 + operator + alerts
-    assert [r.ok for r in results] == [True] * 10
+    # + L3 histograms + L3 query planner + L4 + L5 + operator + alerts
+    assert [r.ok for r in results] == [True] * 11
     assert results[1].detail.startswith("skipped")
 
 
@@ -238,6 +238,82 @@ def test_probe_libtpu_flags_unmapped_advertised_names(capsys):
     assert "does not consume" in out
     # mapped names are not flagged
     assert f"{libtpu_proto.DUTY_CYCLE}  <- unmapped" not in out
+
+
+# ---- query-planner probe ----------------------------------------------------
+
+
+def _planner_payload(**overrides):
+    doc = {
+        "rules": [
+            {"record": "a", "agree": True},
+            {"record": "b", "agree": True},
+        ],
+        "agree_all": True,
+        "fastpath": 12,
+        "fallback": 3,
+        "series_cache_hits": 40,
+        "series_resolves": 2,
+    }
+    doc.update(overrides)
+    return json.dumps(doc)
+
+
+def test_check_query_planner_ok():
+    from k8s_gpu_hpa_tpu.doctor import check_query_planner
+
+    detail = check_query_planner(_planner_payload())
+    assert "2 rules planned==naive" in detail
+    assert "fastpath 12" in detail
+
+
+def test_check_query_planner_flags_disagreement():
+    from k8s_gpu_hpa_tpu.doctor import check_query_planner
+
+    payload = _planner_payload(
+        rules=[
+            {"record": "a", "agree": True},
+            {"record": "tpu_test_tensorcore_avg", "agree": False},
+        ],
+        agree_all=False,
+    )
+    with pytest.raises(AssertionError, match="tpu_test_tensorcore_avg"):
+        check_query_planner(payload)
+
+
+def test_check_query_planner_flags_dead_fastpath():
+    from k8s_gpu_hpa_tpu.doctor import check_query_planner
+
+    with pytest.raises(AssertionError, match="fast path never taken"):
+        check_query_planner(_planner_payload(fastpath=0))
+
+
+def test_diagnose_query_planner_probe_against_live_db():
+    """The probe end-to-end: selfcheck payload from a real populated TSDB
+    through diagnose, not a canned dict."""
+    from k8s_gpu_hpa_tpu.metrics.planner import QueryPlanner, planner_selfcheck
+    from k8s_gpu_hpa_tpu.metrics.rules import (
+        Avg,
+        AvgOverTime,
+        RecordingRule,
+    )
+    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock, retention=7200.0, chunk_size=16)
+    for _ in range(120):
+        clock.advance(5.0)
+        for pod in ("p0", "p1"):
+            db.append("m", (("pod", pod),), 50.0)
+    rules = [
+        RecordingRule(record="m_avg", expr=Avg(AvgOverTime("m", 500.0, {})))
+    ]
+    payload = json.dumps(planner_selfcheck(db, rules, QueryPlanner(db)))
+    results = diagnose(planner_fetch=lambda: payload)
+    by_name = {r.name: r for r in results}
+    assert by_name["L3 query planner"].ok, by_name["L3 query planner"].detail
+    assert "planned==naive" in by_name["L3 query planner"].detail
 
 
 # ---- quantum operator probe -------------------------------------------------

@@ -1,0 +1,186 @@
+"""The PromQL front-end (metrics/promql.py) and the planner's bit-identity
+contract (metrics/planner.py).
+
+Two halves of the ISSUE 7 query engine are pinned here:
+
+- round-trip: every expression a shipped rule factory builds must survive
+  ``parse(e.promql()) == e`` (the string means what the AST means), and
+  every rendered string must re-render unchanged — the property
+  ``tools/lint_promql_parity.py`` enforces on the generated manifests in
+  tier-1;
+- differential: on randomized series/chunk layouts (NaN staleness markers,
+  windows cutting mid-chunk, unsealed head points, series created after
+  planning) the planner's physical plans must produce vectors BIT-identical
+  to the naive AST walk — same length, same order, same labels, same float
+  bits.  "Close enough" is not a property a planner can hold: the HPA's
+  tolerance band turns a 1-ulp drift into a different replica count.
+"""
+
+import random
+
+import pytest
+
+from k8s_gpu_hpa_tpu.control.scale_harness import _vectors_identical
+from k8s_gpu_hpa_tpu.manifests import shipped_rule_groups
+from k8s_gpu_hpa_tpu.metrics.planner import QueryPlanner
+from k8s_gpu_hpa_tpu.metrics.promql import PromQLError, parse, parse_duration
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    Aggregate,
+    AggregateBy,
+    Avg,
+    AvgOverTime,
+    Cmp,
+    MaxBy,
+    Select,
+    shipped_alert_rules,
+)
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs.slo import shipped_slo_alerts
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def lbl(**kw):
+    return tuple(sorted(kw.items()))
+
+
+def _shipped_exprs():
+    """Every Expr the shipped manifests render, labeled for test ids."""
+    out = []
+    for group, rules in shipped_rule_groups():
+        for rule in rules:
+            out.append((f"{group}/{rule.record}", rule.expr))
+    for alert in shipped_alert_rules() + shipped_slo_alerts():
+        out.append((f"alert/{alert.alert}", alert.expr))
+    return out
+
+
+SHIPPED = _shipped_exprs()
+
+
+@pytest.mark.parametrize(
+    "expr", [e for _, e in SHIPPED], ids=[name for name, _ in SHIPPED]
+)
+def test_shipped_expr_round_trips_through_parser(expr):
+    text = expr.promql()
+    assert parse(text) == expr
+    # the rendered form is the fixed point: parse . promql == id on strings
+    assert parse(text).promql() == text
+
+
+def test_parse_duration_inverts_window_formatting():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("6h") == 21600.0
+    assert parse_duration("1d") == 86400.0
+    with pytest.raises(PromQLError):
+        parse_duration("5x")
+
+
+def test_parser_canonicalizes_each_aggregation_form():
+    assert parse("avg(m)") == Avg(Select("m", {}))
+    assert parse("sum(m)") == Aggregate("sum", Select("m", {}))
+    assert parse('max by(pod,node)(m{job="x"})') == MaxBy(
+        ("pod", "node"), Select("m", {"job": "x"})
+    )
+    assert parse("sum by(shard)(m)") == AggregateBy("sum", ("shard",), Select("m", {}))
+    assert parse("avg_over_time(m[5m])") == AvgOverTime("m", 300.0, {})
+
+
+def test_parser_rejects_inputs_outside_the_subset():
+    for bad in (
+        "m + n",  # arithmetic the subset does not model
+        "m * n",  # bare * (only the on/group_left join)
+        "avg(m) extra",  # trailing input
+        "(1 - (increase(g[5m]) / increase(t[6m]))) / 0.05",  # window mismatch
+        "avg(m",  # unbalanced
+        "m{job=~\"x\"}",  # regex matchers unsupported
+        "3",  # scalar, not a vector query
+    ):
+        with pytest.raises(PromQLError):
+            parse(bad)
+
+
+# ---------------------------------------------------------------------------
+# differential: planned vs naive on randomized layouts
+
+
+def _random_db(rng: random.Random):
+    """A TSDB whose layout hits every read path: several sealed Gorilla
+    chunks per series (small chunk_size), NaN staleness markers sprinkled
+    in, and a live unsealed head."""
+    clock = VirtualClock()
+    # chunk_size 16: ~200 ticks seal ~12 chunks/series; retention keeps all
+    db = TimeSeriesDB(clock, lookback=300.0, retention=86400.0, chunk_size=16)
+    pods = [f"p{i}" for i in range(rng.randint(3, 7))]
+    ticks = rng.randint(150, 220)
+    for tick in range(ticks):
+        clock.advance(rng.choice((1.0, 5.0, 5.0, 15.0)))
+        for i, pod in enumerate(pods):
+            if rng.random() < 0.15:
+                continue  # scrape gap: series tick without a point
+            value = float("nan") if rng.random() < 0.08 else rng.uniform(0.0, 100.0)
+            db.append("m", lbl(pod=pod, shard=str(i % 2), job="fleet"), value)
+    return db, pods
+
+
+def _basket(rng: random.Random):
+    """Expression shapes the pipeline actually runs, with windows chosen to
+    cut mid-chunk (boundary decode) and cover sealed chunks (summary path)."""
+    window = rng.choice((120.0, 300.0, 700.0))
+    return [
+        Select("m", {}),
+        Select("m", {"shard": "0"}),
+        Avg(Select("m", {"job": "fleet"})),
+        MaxBy(("pod",), Select("m", {})),
+        Aggregate("sum", Select("m", {})),
+        AggregateBy("sum", ("shard",), Select("m", {})),
+        AvgOverTime("m", window, {}),
+        Avg(AvgOverTime("m", window, {"shard": "1"})),
+        Cmp(Avg(Select("m", {})), ">", 50.0),
+    ]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_planned_execution_is_bit_identical_to_naive(seed):
+    rng = random.Random(seed)
+    db, pods = _random_db(rng)
+    planner = QueryPlanner(db)
+    exprs = _basket(rng)
+    plans = [planner.plan(e) for e in exprs]
+    for expr, plan in zip(exprs, plans):
+        assert _vectors_identical(expr.evaluate(db), plan.evaluate(db)), (
+            f"seed={seed} diverged on {expr.promql()}"
+        )
+    # mutate after planning: more points, then a series created AFTER the
+    # plans were built (generation bump must invalidate cached series sets)
+    for _ in range(40):
+        db.clock.advance(5.0)
+        for i, pod in enumerate(pods):
+            db.append(
+                "m",
+                lbl(pod=pod, shard=str(i % 2), job="fleet"),
+                rng.uniform(0.0, 100.0),
+            )
+    db.append("m", lbl(pod="late-joiner", shard="0", job="fleet"), 42.0)
+    for expr, plan in zip(exprs, plans):
+        assert _vectors_identical(expr.evaluate(db), plan.evaluate(db)), (
+            f"seed={seed} diverged after mutation on {expr.promql()}"
+        )
+    # the layout must have exercised BOTH range paths: summary-served chunks
+    # and boundary/head decodes — otherwise the property is vacuous
+    assert planner.stats.fastpath > 0
+    assert planner.stats.fallback > 0
+
+
+def test_planner_selfcheck_agrees_on_shipped_rules():
+    """The doctor probe's payload generator: planned and naive evaluation
+    of every shipped rule agree on a live DB."""
+    from k8s_gpu_hpa_tpu.metrics.planner import planner_selfcheck
+
+    rng = random.Random(99)
+    db, _ = _random_db(rng)
+    rules = [r for _, group in shipped_rule_groups() for r in group]
+    report = planner_selfcheck(db, rules, QueryPlanner(db))
+    assert report["agree_all"] is True
+    assert len(report["rules"]) == len(rules)
+    assert all(entry["agree"] for entry in report["rules"])

@@ -31,9 +31,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
 from k8s_gpu_hpa_tpu.obs.selfmetrics import (  # noqa: E402
     ADAPTER_QUERY_LATENCY,
+    DECODE_CACHE_HITS,
+    DECODE_CACHE_MISSES,
     HPA_DECISION_TOTAL,
     HPA_SYNC_DURATION,
     HPA_SYNC_LATENCY,
+    PLANNER_FALLBACK_TOTAL,
+    PLANNER_FASTPATH_TOTAL,
+    PLANNER_SERIES_CACHE_HITS,
+    PLANNER_SERIES_RESOLVES,
     RULE_EVAL_LATENCY,
     RULE_EVAL_STALENESS,
     SCRAPE_DURATION,
@@ -617,6 +623,65 @@ def build_dashboard() -> dict:
             )
             for i, slo in enumerate(shipped_slos())
         ],
+        # ---- query engine (metrics/planner.py): how reads are served ----
+        _ts_panel(
+            30,
+            "Query engine: planner pushdown",
+            0,
+            112,
+            [
+                _target(
+                    f"rate({PLANNER_FASTPATH_TOTAL}[5m])",
+                    "summary fast path (chunks/s)",
+                    "A",
+                ),
+                _target(
+                    f"rate({PLANNER_FALLBACK_TOTAL}[5m])",
+                    "decode fallback (chunks/s)",
+                    "B",
+                ),
+                _target(
+                    f"rate({PLANNER_SERIES_CACHE_HITS}[5m])",
+                    "series cache hits/s",
+                    "C",
+                ),
+                _target(
+                    f"rate({PLANNER_SERIES_RESOLVES}[5m])",
+                    "index re-resolves/s",
+                    "D",
+                ),
+            ],
+            "Planned rule evaluation's pushdown counters: chunks served "
+            "from seal-time summaries without a Gorilla decode vs decoded "
+            "(window boundary or live head), and series sets revalidated "
+            "from the plan cache vs re-resolved through the inverted index. "
+            "Steady state is fast-path/cache-hit dominated; a flip toward "
+            "fallback/resolve means the layout churned (or the planner "
+            "stopped engaging — see the doctor's check_query_planner).",
+        ),
+        _ts_panel(
+            31,
+            "Query engine: decoded-window cache",
+            12,
+            112,
+            [
+                _target(
+                    f"rate({DECODE_CACHE_HITS}[5m])",
+                    "cache hits/s",
+                    "A",
+                ),
+                _target(
+                    f"rate({DECODE_CACHE_MISSES}[5m])",
+                    "decodes/s",
+                    "B",
+                ),
+            ],
+            "Sealed-chunk column reads served from the TSDB's bounded "
+            "decoded-window cache vs decoded fresh from Gorilla blobs.  "
+            "Plans sharing boundary chunks reuse each other's decodes; a "
+            "miss-dominated panel under a steady rule set means the cache "
+            "is thrashing (too many distinct chunks in the hot window).",
+        ),
     ]
     return {
         "title": "TPU HPA pipeline",

@@ -1,0 +1,116 @@
+"""Lint PromQL parity: the shipped manifest strings must MEAN the rule ASTs.
+
+tools/gen_prometheusrule.py renders deploy/tpu-test-prometheusrule.yaml from
+the tested expression ASTs (metrics/rules.py), and tests/test_manifests.py
+pins the file bytes — but bytes-equality only proves the renderer ran, not
+that the strings denote the semantics the closed loop evaluates.  This lint
+closes the loop with the parser (metrics/promql.py):
+
+- **round-trip**: every ``expr:`` string in the shipped manifest must parse
+  back to an AST structurally equal (dataclass ``==``) to the in-process
+  registry's AST for that record/alert, and re-render to the same string;
+- **one-sided rules**: a record/alert present in the manifest but absent
+  from the registry (or vice versa) fails — a rule only Prometheus runs, or
+  only the simulator runs, is exactly the drift this repo exists to prevent.
+
+Usage:
+    python tools/lint_promql_parity.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import yaml  # noqa: E402
+
+from k8s_gpu_hpa_tpu.manifests import shipped_rule_groups  # noqa: E402
+from k8s_gpu_hpa_tpu.metrics.promql import PromQLError, parse  # noqa: E402
+from k8s_gpu_hpa_tpu.metrics.rules import shipped_alert_rules  # noqa: E402
+from k8s_gpu_hpa_tpu.obs.slo import shipped_slo_alerts  # noqa: E402
+
+MANIFEST = REPO / "deploy" / "tpu-test-prometheusrule.yaml"
+
+
+def _registry() -> dict[str, list]:
+    """``record:`` / ``alert:`` name -> the Exprs the closed loop evaluates
+    under that name (a list: alert names legitimately repeat — the tensorcore
+    and serve rungs each ship a ``TpuAutoscaleSignalFlatZero`` guard)."""
+    registry: dict[str, list] = {}
+    for _, rules in shipped_rule_groups():
+        for rule in rules:
+            registry.setdefault(f"record/{rule.record}", []).append(rule.expr)
+    for alert in shipped_alert_rules() + shipped_slo_alerts():
+        registry.setdefault(f"alert/{alert.alert}", []).append(alert.expr)
+    return registry
+
+
+def lint_parity(manifest_path: Path | None = None) -> list[str]:
+    """Every parity violation in the shipped manifest, as readable strings."""
+    manifest_path = manifest_path or MANIFEST
+    doc = yaml.safe_load(manifest_path.read_text())
+    registry = _registry()
+    errors: list[str] = []
+    for group in doc["spec"]["groups"]:
+        for entry in group["rules"]:
+            kind = "record" if "record" in entry else "alert"
+            key = f"{kind}/{entry[kind]}"
+            text = entry["expr"]
+            candidates = registry.get(key)
+            if not candidates:
+                errors.append(
+                    f"{key}: in the manifest but not in the in-process "
+                    "registry (one-sided: only Prometheus would run it)"
+                )
+                continue
+            try:
+                ast = parse(text)
+            except PromQLError as e:
+                errors.append(f"{key}: manifest expr does not parse: {e}")
+                continue
+            if ast in candidates:
+                candidates.remove(ast)  # matched: consume the registry copy
+            else:
+                errors.append(
+                    f"{key}: manifest expr parses to a DIFFERENT AST than "
+                    f"the registry evaluates:\n  manifest: {text}\n"
+                    "  registry: "
+                    + " | ".join(e.promql() for e in candidates)
+                )
+                continue
+            if ast.promql() != text:
+                errors.append(
+                    f"{key}: expr is not the canonical rendering "
+                    f"({text!r} -> {ast.promql()!r})"
+                )
+    for key, leftovers in sorted(registry.items()):
+        for expr in leftovers:
+            errors.append(
+                f"{key}: in the in-process registry but not in the manifest "
+                f"(one-sided: only the simulator would run it): {expr.promql()}"
+            )
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    if argv:
+        print(__doc__.split("Usage:")[1].strip(), file=sys.stderr)
+        return 2
+    errors = lint_parity()
+    for err in errors:
+        print(f"lint_promql_parity: {err}")
+    if errors:
+        return 1
+    n = sum(len(v) for v in _registry().values())
+    print(
+        f"lint_promql_parity ok: {n} manifest expressions parse back to "
+        "the exact ASTs the closed loop evaluates"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

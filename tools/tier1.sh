@@ -26,6 +26,10 @@ python tools/profile_sim.py --preset sim_scale_10k --smoke --assert-gates || exi
 # fault-registry lint: every chaos fault kind must have an injector, a
 # docstring row, and at least one test referencing it
 python tools/lint_faults.py || exit 1
+# PromQL parity lint: every expr string in the generated PrometheusRule
+# manifest must parse (metrics/promql.py) back to the exact AST the closed
+# loop evaluates, and no rule may exist on only one side
+python tools/lint_promql_parity.py || exit 1
 # recovery-drill smoke (small sizing: one component): kill the TSDB mid-run,
 # replay its WAL, and require reconvergence with zero spurious scale events
 # and lineage-complete traces — exit 0 IS the durability contract
