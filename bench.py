@@ -1757,6 +1757,55 @@ def run_rung_query_bench() -> dict:
     return result
 
 
+def run_rung_downsample_bench() -> dict:
+    """Long-horizon rollup rung (metrics/downsample.py + scale_harness): a
+    day of fleet history aged through the 5m/1h compactor, then one
+    tier-aligned 20 h fleet query read from the 1h rollups vs the same
+    window rescanned from raw chunk decodes.  Gates (perfgates.py): the
+    rollup read bit-identical to the raw bucketed twin (and the randomized
+    differential clean), wall-time speedup at least MIN_ROLLUP_SPEEDUP,
+    rollup bytes for the aged span within MAX_ROLLUP_BYTES_RATIO of the
+    16-byte uncompressed samples they summarize, and the planner actually
+    selecting the tier (a silent raw fallback would otherwise pass on
+    identical-but-slow results)."""
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.control.scale_harness import run_downsample_bench
+
+    if TIME_SCALE == 1.0:
+        result = run_downsample_bench(
+            targets=perfgates.DOWNSAMPLE_BENCH_TARGETS,
+            shards=perfgates.DOWNSAMPLE_BENCH_SHARDS,
+            horizon_s=perfgates.DOWNSAMPLE_BENCH_HORIZON_S,
+            scrape_interval=perfgates.DOWNSAMPLE_BENCH_INTERVAL_S,
+            window_s=perfgates.DOWNSAMPLE_BENCH_WINDOW_S,
+            at_s=perfgates.DOWNSAMPLE_BENCH_AT_S,
+        )
+        floor = perfgates.MIN_ROLLUP_SPEEDUP
+    else:  # smoke sizing: same cadence (bucket density), ~50x less work
+        result = run_downsample_bench(
+            targets=perfgates.DOWNSAMPLE_SMOKE_TARGETS,
+            shards=perfgates.DOWNSAMPLE_SMOKE_SHARDS,
+            horizon_s=perfgates.DOWNSAMPLE_SMOKE_HORIZON_S,
+            scrape_interval=perfgates.DOWNSAMPLE_SMOKE_INTERVAL_S,
+            window_s=perfgates.DOWNSAMPLE_SMOKE_WINDOW_S,
+            at_s=perfgates.DOWNSAMPLE_SMOKE_AT_S,
+        )
+        floor = perfgates.DOWNSAMPLE_SMOKE_MIN_ROLLUP_SPEEDUP
+    result["mode"] = "virtual"
+    result["metric"] = "rollup tier vs raw rescan (wall-time speedup)"
+    result["speedup_floor"] = floor
+    result["meets_floor"] = result["speedup"] >= floor
+    result["bytes_ratio_budget"] = perfgates.MAX_ROLLUP_BYTES_RATIO
+    result["ok"] = (
+        result["identical"]
+        and result["differential"]["identical"]
+        and result["meets_floor"]
+        and result["bytes_ratio"] <= perfgates.MAX_ROLLUP_BYTES_RATIO
+        and result["tier_selected"]
+    )
+    return result
+
+
 def run_rung_sim_scale() -> dict:
     """Fleet-scale metrics-plane rung (control/scale_harness.py): a full
     pipeline plus 1000 synthetic structured scrape targets driven over a
@@ -2235,6 +2284,7 @@ def main() -> None:
             ("sim_scale", run_rung_sim_scale),
             ("sim_scale_10k", run_rung_sim_scale_10k),
             ("query_bench", run_rung_query_bench),
+            ("downsample_bench", run_rung_downsample_bench),
             ("recovery_drill", run_rung_recovery_drill),
         ):
             log(f"rung {name}:")

@@ -206,10 +206,24 @@ def main(argv: list[str] | None = None) -> int:
             "trace",
             "drill",
             "slo",
+            "history",
+            "why",
         ],
         default="spike",
     )
     sim.add_argument("--duration", type=float, default=420.0)
+    sim.add_argument(
+        "--days",
+        type=float,
+        default=2.0,
+        help="virtual days the history/why flight-recorder run covers",
+    )
+    sim.add_argument(
+        "--event",
+        type=int,
+        default=None,
+        help="scale-event span id for --scenario why (listed by history)",
+    )
     sim.add_argument("--pod-start", type=float, default=12.0)
     sim.add_argument(
         "--trace-out",

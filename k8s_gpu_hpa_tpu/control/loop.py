@@ -68,6 +68,7 @@ class AutoscalingPipeline:
         wal=None,
         checkpoint_store=None,
         scrape_shards: int = 0,
+        downsample=None,
     ):
         self.cluster = cluster
         self.deployment = deployment
@@ -113,13 +114,18 @@ class AutoscalingPipeline:
                 interval=self.intervals.scrape,
                 tracer=tracer,
                 selfmetrics=self.selfmetrics,
+                downsample=downsample,
             )
             self.db = FederatedTSDB(
-                TimeSeriesDB(clock, wal=wal), self.shard_plane.shard_dbs
+                TimeSeriesDB(clock, wal=wal, downsample=downsample),
+                self.shard_plane.shard_dbs,
             )
             self.scraper = self.shard_plane
         else:
-            self.db = TimeSeriesDB(clock, wal=wal)
+            # downsample (a DownsamplePolicy) turns on long-horizon rollup
+            # compaction — the flight-recorder scenarios and history bench
+            # pass one; the live control loop defaults to raw-only
+            self.db = TimeSeriesDB(clock, wal=wal, downsample=downsample)
             self.scraper = Scraper(
                 self.db,
                 interval=self.intervals.scrape,
@@ -343,11 +349,17 @@ class AutoscalingPipeline:
                 lookback=old.lookback,
                 retention=old.retention,
                 snapshot_every=old.snapshot_every,
+                chunk_size=old.chunk_size,
+                downsample=old.downsample_policy,
             )
             info = dict(db.last_recovery or {})
         else:
             db = TimeSeriesDB(
-                self._clock, lookback=old.lookback, retention=old.retention
+                self._clock,
+                lookback=old.lookback,
+                retention=old.retention,
+                chunk_size=old.chunk_size,
+                downsample=old.downsample_policy,
             )
             info = {"snapshot_restored": False, "recovered_points": 0}
         self.db = db

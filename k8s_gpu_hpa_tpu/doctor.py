@@ -339,6 +339,67 @@ def check_query_planner(payload: str) -> str:
     )
 
 
+def check_downsampling(payload: str) -> str:
+    """Long-horizon rollup-tier health (TSDBs running a DownsamplePolicy,
+    metrics/downsample.py): every configured tier holds sealed buckets,
+    and on tier-aligned windows where raw retention still overlaps rollup
+    coverage the rollup fold returns the SAME floats as re-bucketing the
+    raw points.  A tier with zero buckets means compaction silently
+    stopped (horizon misconfigured, or the append/evict hooks detached);
+    a disagreement means the flight recorder and ``simulate history`` are
+    narrating numbers the raw store never produced — distrust every
+    long-horizon readout until fixed.  ``payload`` is
+    ``downsample_selfcheck(...)`` JSON."""
+    doc = json.loads(payload)
+    if not doc.get("enabled", False):
+        raise AssertionError(
+            "no downsample policy on this TSDB — long-horizon queries are "
+            "serving raw decode only (enable DownsamplePolicy to get tiers)"
+        )
+    tiers = doc.get("tiers", {})
+    empty = sorted(t for t, e in tiers.items() if e.get("buckets", 0) <= 0)
+    if not tiers or empty:
+        raise AssertionError(
+            "rollup tier(s) hold no sealed buckets: "
+            + (", ".join(empty) or "(none configured)")
+            + " — compaction is not running (pipeline younger than "
+            "step+horizon, or the downsampler lost its append/evict hooks)"
+        )
+    disagree = [
+        f"{a['name']}@{a['tier']}"
+        for a in doc.get("agreement", [])
+        if a.get("served") and not a.get("agree")
+    ]
+    if not doc.get("agree_all", True) or disagree:
+        raise AssertionError(
+            "rollup fold DISAGREES with the raw twin for: "
+            + (", ".join(disagree) or "(unreported windows)")
+            + " — long-horizon rollup reads are not faithful to raw history"
+        )
+    served = doc.get("windows_served", 0)
+    if served <= 0:
+        raise AssertionError(
+            f"no tier-aligned window could be differentially verified "
+            f"({doc.get('windows_skipped', 0)} skipped): rollup coverage "
+            "never overlaps raw retention — probe from a DB whose raw "
+            "window still holds compacted points"
+        )
+    tier_bits = ", ".join(
+        f"{label} {e.get('buckets', 0)} bucket(s)/"
+        f"{e.get('bytes', 0)} B (lag "
+        + (
+            f"{e['coverage_lag_s']:.0f}s"
+            if e.get("coverage_lag_s") is not None
+            else "n/a"
+        )
+        + ")"
+        for label, e in sorted(tiers.items())
+    )
+    return (
+        f"{served} aligned window(s) rollup==raw twin; {tier_bits}"
+    )
+
+
 def check_custom_metrics_api(payload: str, metric: str) -> str:
     """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
     doc = json.loads(payload)
@@ -433,6 +494,7 @@ def diagnose(
     self_exposition_fetch: Callable[[], str] | None = None,
     shards_fetch: Callable[[], str] | None = None,
     planner_fetch: Callable[[], str] | None = None,
+    downsample_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -479,6 +541,13 @@ def diagnose(
             "planned rule evaluation bit-agrees with naive, fast path live",
             (lambda: check_query_planner(planner_fetch()))
             if planner_fetch
+            else None,
+        ),
+        (
+            "L3 rollup tiers",
+            "downsample tiers hold buckets, rollup folds bit-agree with raw",
+            (lambda: check_downsampling(downsample_fetch()))
+            if downsample_fetch
             else None,
         ),
         (

@@ -88,6 +88,7 @@ class ShardedScrapePlane:
         ring: HashRing | None = None,
         tracer=None,
         selfmetrics=None,
+        downsample=None,
     ):
         self.clock = clock
         self.ring = ring or HashRing(shards)
@@ -98,7 +99,11 @@ class ShardedScrapePlane:
         self.interval = interval
         self.shard_dbs = [
             TimeSeriesDB(
-                clock, lookback=lookback, retention=retention, chunk_size=chunk_size
+                clock,
+                lookback=lookback,
+                retention=retention,
+                chunk_size=chunk_size,
+                downsample=downsample,
             )
             for _ in range(shards)
         ]
@@ -335,6 +340,92 @@ class FederatedTSDB:
                 out.extend(vec)
         return out
 
+    # -- downsampled rollup tiers (fan out like any read) --------------------
+
+    @property
+    def rollup_steps(self) -> tuple[float, ...]:
+        """Union of the members' tier menus (shards and the global DB may
+        downsample independently; the planner only needs to know a step
+        exists somewhere to try it)."""
+        steps: set[float] = set()
+        for db in self.members:
+            steps.update(db.rollup_steps)
+        return tuple(sorted(steps))
+
+    @property
+    def downsample_policy(self):
+        for db in self.members:
+            policy = db.downsample_policy
+            if policy is not None:
+                return policy
+        return None
+
+    def rollup_range_avg(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        window_s: float = 0.0,
+        at: float | None = None,
+        step: float | None = None,
+        stats=None,
+    ) -> list[Sample] | None:
+        """Tier read across members: every member holding matching series
+        must serve the tier, else the whole federated query reports None
+        (mixing tier and raw members would break the bit-exactness
+        contract).  Members without matching series contribute []."""
+        at = self.clock.now() if at is None else at
+        out: list[Sample] = []
+        for db in self.members:
+            vec = db.rollup_range_avg(name, matchers, window_s, at, step, stats=stats)
+            if vec is None:
+                return None
+            out.extend(vec)
+        return out
+
+    def range_avg_bucketed(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        window_s: float = 0.0,
+        at: float | None = None,
+        step: float | None = None,
+    ) -> list[Sample]:
+        at = self.clock.now() if at is None else at
+        out: list[Sample] = []
+        for db in self.members:
+            out.extend(db.range_avg_bucketed(name, matchers, window_s, at, step=step))
+        return out
+
+    def rollup_rows(self, *args, **kwargs) -> list:
+        out: list = []
+        for db in self.members:
+            out.extend(db.rollup_rows(*args, **kwargs))
+        return out
+
+    def rollup_storage_stats(self) -> dict:
+        merged: dict = {"enabled": False, "tiers": {}}
+        for db in self.members:
+            stats = db.rollup_storage_stats()
+            if not stats.get("enabled"):
+                continue
+            merged["enabled"] = True
+            for label, entry in stats["tiers"].items():
+                slot = merged["tiers"].setdefault(
+                    label, {"series": 0, "chunks": 0, "buckets": 0, "bytes": 0}
+                )
+                for k, v in entry.items():
+                    slot[k] += v
+            for key in (
+                "rollup_bytes",
+                "ingested_points",
+                "ingested_chunks",
+                "ingested_bytes",
+                "sealed_buckets",
+                "dropped_buckets",
+            ):
+                merged[key] = merged.get(key, 0) + stats[key]
+        return merged
+
     def latest(self, name: str, matchers: dict[str, str] | None = None) -> float | None:
         vec = self.instant_vector(name, matchers)
         if not vec:
@@ -347,7 +438,9 @@ class FederatedTSDB:
         for db in self.members:
             db.begin_capture()
 
-    def end_capture(self) -> list[tuple[str, LabelSet, float, float, int | None]]:
+    def end_capture(
+        self,
+    ) -> list[tuple[str, LabelSet, float, float, int | None, str]]:
         captured: list = []
         for db in self.members:
             captured.extend(db.end_capture())

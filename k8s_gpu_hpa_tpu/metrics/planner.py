@@ -13,6 +13,12 @@ replaced — and rule evaluation runs the plan thereafter:
   per-name counter that bumps only when a series is created or GC-dropped,
   so the dominant steady-state eval skips index intersection entirely and
   goes straight to the per-series last-point fast path.
+- **rollup tier selection** (:class:`_PlannedAvgOverTime`): a range query
+  whose window and ``at`` are both aligned to a downsampled rollup step
+  (metrics/downsample.py) reads the coarsest such tier — bit-exact for
+  avg/sum/count by the shared bucket fold — and falls back to finer tiers
+  and then raw whenever coverage is incomplete, counted per tier in
+  ``PlannerStats.rollup_reads``/``rollup_fallbacks``.
 - **chunk-summary aggregation pushdown** (:class:`_PlannedAvgOverTime`): a
   sealed chunk fully inside the query window contributes the
   ``(count, sum, min, max, nan_count)`` summary recorded at seal time
@@ -36,7 +42,7 @@ naively; the planner never guesses.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.rules import (
     Absent,
@@ -56,6 +62,7 @@ from k8s_gpu_hpa_tpu.metrics.rules import (
     Select,
     Vector,
 )
+from k8s_gpu_hpa_tpu.metrics.downsample import tier_label as _tier_label
 from k8s_gpu_hpa_tpu.metrics.schema import Sample
 
 
@@ -67,13 +74,24 @@ class PlannerStats:
     from the seal-time summary without decode vs decoded (window boundary or
     head).  ``series_cache_hits``/``series_resolves`` count per-eval series
     set validations: revalidated-from-cache vs re-resolved through the
-    inverted index."""
+    inverted index.
+
+    Rollup tiers (metrics/downsample.py): ``rollup_reads`` counts range
+    queries served per tier label (``{"1h": 3, ...}``),
+    ``rollup_fallbacks`` counts tier-eligible queries that fell back to raw
+    (coverage hole), and ``rollup_fastpath``/``rollup_fallback`` mirror the
+    chunk counters at rollup-chunk granularity (seal-summary-served vs
+    decoded)."""
 
     fastpath: int = 0
     fallback: int = 0
     series_cache_hits: int = 0
     series_resolves: int = 0
     plans_built: int = 0
+    rollup_reads: dict = field(default_factory=dict)
+    rollup_fallbacks: int = 0
+    rollup_fastpath: int = 0
+    rollup_fallback: int = 0
 
 
 class PlannedSelect(Select):
@@ -134,20 +152,44 @@ class PlannedSelect(Select):
                     if value != value or at - pt_ts > lookback:
                         continue
                 if capture is not None:
-                    capture.append((name, series.labels, pt_ts, value, origin))
+                    capture.append(
+                        (name, series.labels, pt_ts, value, origin, "raw")
+                    )
                 out.append(Sample(value, series.labels))
         return out
 
 
 class _PlannedAvgOverTime(AvgOverTime):
     """Physical range aggregate: chunk-summary pushdown via
-    ``TimeSeriesDB.range_avg(use_summaries=True)``."""
+    ``TimeSeriesDB.range_avg(use_summaries=True)``, preceded by rollup
+    **tier selection** — a window and ``at`` both aligned to a rollup step
+    (and no finer than it) reads the coarsest such tier instead of raw,
+    bit-exact by the shared bucket fold, falling to finer tiers and then
+    raw when a tier can't cover the query (``stats.rollup_fallbacks``)."""
 
     def __init__(self, src: AvgOverTime, stats: PlannerStats):
         super().__init__(src.name, src.window, dict(src.matchers))
         self._stats = stats
 
     def evaluate(self, db, at: float | None = None) -> Vector:
+        stats = self._stats
+        steps = getattr(db, "rollup_steps", ())
+        if steps:
+            at_v = db.clock.now() if at is None else at
+            window = self.window
+            eligible = False
+            for step in reversed(steps):  # coarsest aligned tier first
+                if window < step or window % step != 0.0 or at_v % step != 0.0:
+                    continue
+                eligible = True
+                vec = db.rollup_range_avg(
+                    self.name, self.matchers, window, at_v, step, stats=stats
+                )
+                if vec is not None:
+                    return vec
+            if eligible:
+                stats.rollup_fallbacks += 1
+            at = at_v
         return db.range_avg(
             self.name,
             self.matchers,
@@ -279,10 +321,19 @@ class QueryPlanner:
                     "  [series-set cache (gen-validated) + last-point fast path]"
                 )
             elif isinstance(node, _PlannedAvgOverTime):
+                steps = getattr(self.db, "rollup_steps", ())
+                tiers = (
+                    "tier selection over "
+                    + "/".join(_tier_label(s) for s in reversed(steps))
+                    + " rollups, then "
+                    if steps
+                    else ""
+                )
                 lines.append(
                     f"{pad}RangeAgg avg_over_time[{int(node.window)}s] "
                     f"{Select(node.name, node.matchers).promql()}"
-                    "  [chunk-summary pushdown; boundary chunks via decode cache]"
+                    f"  [{tiers}chunk-summary pushdown; boundary chunks via"
+                    " decode cache]"
                 )
             elif isinstance(node, _PlannedHistogramQuantile):
                 lines.append(f"{pad}HistogramQuantile q={node.q:g}")
@@ -356,6 +407,10 @@ def planner_selfcheck(
         "series_cache_hits": s.series_cache_hits,
         "series_resolves": s.series_resolves,
         "plans_built": s.plans_built,
+        "rollup_reads": dict(s.rollup_reads),
+        "rollup_fallbacks": s.rollup_fallbacks,
+        "rollup_fastpath": s.rollup_fastpath,
+        "rollup_fallback": s.rollup_fallback,
         "decode_cache_hits": getattr(db, "decode_cache_hits", 0),
         "decode_cache_misses": getattr(db, "decode_cache_misses", 0),
     }

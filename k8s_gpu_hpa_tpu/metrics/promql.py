@@ -38,6 +38,16 @@ A parenthesized division of two vector expressions is the federation
 :class:`Ratio`.  Anything outside the subset raises :class:`PromQLError`
 with the offending position — a parser that silently guessed would turn the
 parity lint into noise.
+
+A second entry point, :func:`parse_query`, accepts the strictly-larger
+QUERY subset the Grafana dashboard uses — ``rate()``, bare ``increase()``,
+``!=``/``=~``/``!~`` matchers, ``or vector(N)``, and
+``histogram_quantile`` over a general bucket expression — canonicalized to
+query-only nodes (:class:`Rate`, :class:`Increase`, :class:`QSelect`,
+:class:`OrVector`, :class:`QHistogramQuantile`) that render but do not
+evaluate; ``tools/lint_promql_parity.py`` holds every dashboard panel
+target to the same parse-and-canonical-render contract as the rule
+manifest.
 """
 
 from __future__ import annotations
@@ -60,11 +70,104 @@ from k8s_gpu_hpa_tpu.metrics.rules import (
     MulOnGroupLeft,
     Ratio,
     Select,
+    _fmt_window,
 )
 
 
 class PromQLError(ValueError):
     """The input is outside the supported PromQL subset (or malformed)."""
+
+
+# -- query-mode nodes ---------------------------------------------------------
+# The Grafana dashboard (tools/gen_grafana_dashboard.py) legitimately uses
+# PromQL the closed loop never evaluates: rate() over self-metric counters,
+# bare increase() outside the burn idiom, !=/=~ label matchers on series
+# Kubernetes owns (ALERTS, kube_*), and the "or vector(0)" stat-panel idiom.
+# These nodes give that QUERY subset the same parse -> canonical-render
+# contract the rule subset has, without teaching the simulator to evaluate
+# queries it never runs: they are Expr subclasses (so they compose inside
+# aggregations) whose evaluate() intentionally stays NotImplemented —
+# tools/lint_promql_parity.py is their only consumer.
+
+
+@dataclass
+class QSelect(Expr):
+    """Selector with general matchers: ``name{key!="v",other=~"re"}`` —
+    matcher triples keep source order (no canonical sort: the dashboard is
+    hand-authored, and order is part of its byte identity)."""
+
+    name: str
+    matchers: tuple[tuple[str, str, str], ...]  # (label, op, value)
+
+    def input_names(self) -> frozenset[str]:
+        return frozenset((self.name,))
+
+    def promql(self) -> str:
+        inner = ",".join(f'{k}{op}"{v}"' for k, op, v in self.matchers)
+        return f"{self.name}{{{inner}}}"
+
+
+@dataclass
+class Rate(Expr):
+    """``rate(selector[window])`` — per-second counter rate."""
+
+    child: Expr  # Select or QSelect
+    window: float
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
+    def promql(self) -> str:
+        return f"rate({self.child.promql()}[{_fmt_window(self.window)}])"
+
+
+@dataclass
+class Increase(Expr):
+    """``increase(selector[window])`` used as a vector in its own right —
+    outside the burn idiom, which still folds to :class:`BurnRate`."""
+
+    child: Expr  # Select or QSelect
+    window: float
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
+    def promql(self) -> str:
+        return f"increase({self.child.promql()}[{_fmt_window(self.window)}])"
+
+
+@dataclass
+class OrVector(Expr):
+    """``child or vector(default)`` — the stat-panel idiom: an empty result
+    renders as the default scalar instead of "No data"."""
+
+    child: Expr
+    default: float
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
+    def promql(self) -> str:
+        return f"{self.child.promql()} or vector({self.default:g})"
+
+
+@dataclass
+class QHistogramQuantile(Expr):
+    """``histogram_quantile(q, expr)`` over a general bucket expression —
+    the dashboard's ``sum by(le)(rate(..._bucket[5m]))`` quantile read (a
+    bare ``_bucket`` selector still canonicalizes to the rule-subset
+    :class:`~.rules.HistogramQuantile`)."""
+
+    q: float
+    child: Expr
+
+    def input_names(self) -> frozenset[str]:
+        return self.child.input_names()
+
+    def promql(self) -> str:
+        q = self.q
+        rendered = str(int(q)) if q == int(q) else repr(q)
+        return f"histogram_quantile({rendered}, {self.child.promql()})"
 
 
 #: aggregation keywords and whether the bare (no ``by``) form has a
@@ -79,7 +182,7 @@ _TOKEN_RE = re.compile(
   | (?P<NUMBER>\d+(?:\.\d+)?)
   | (?P<NAME>[A-Za-z_:][A-Za-z0-9_:]*)
   | (?P<STRING>"(?:[^"\\]|\\.)*")
-  | (?P<OP>==|!=|<=|>=|[<>{}()\[\],=*/+-])
+  | (?P<OP>=~|!~|==|!=|<=|>=|[<>{}()\[\],=*/+-])
     """,
     re.VERBOSE,
 )
@@ -149,10 +252,14 @@ class _OneMinus:
 
 
 class _Parser:
-    def __init__(self, text: str):
+    def __init__(self, text: str, query: bool = False):
         self.text = text
         self.tokens = tokenize(text)
         self.i = 0
+        #: query mode (parse_query): additionally accept the dashboard-only
+        #: constructs — rate(), bare increase(), !=/=~/!~ matchers,
+        #: "or vector(N)", histogram_quantile over a general expression
+        self.query = query
 
     # -- token plumbing ------------------------------------------------------
 
@@ -185,18 +292,39 @@ class _Parser:
     # -- grammar -------------------------------------------------------------
 
     def parse(self) -> Expr:
-        expr = self.parse_and()
+        expr = self.parse_or()
         tok = self.peek()
         if tok.kind != "EOF":
             raise PromQLError(
                 f"trailing input at {tok.pos}: {self.text[tok.pos:]!r}"
             )
-        if not isinstance(expr, Expr):
+        expr = self.vector(expr, "top-level expression")
+        return expr
+
+    def vector(self, x, where: str) -> Expr:
+        """Require a vector Expr; in query mode, lift a bare counter-delta
+        intermediate into the query-only :class:`Increase` node instead of
+        rejecting it (outside the burn idiom it IS a vector query)."""
+        if self.query and isinstance(x, _Increase):
+            return Increase(Select(x.name, x.matchers), x.window)
+        if not isinstance(x, Expr):
             raise PromQLError(
-                f"expression is not a vector query in the supported subset: "
+                f"{where} is not a vector query in the supported subset: "
                 f"{self.text!r}"
             )
-        return expr
+        return x
+
+    def parse_or(self):
+        """Query mode only: ``expr or vector(N)`` — loosest binding."""
+        left = self.parse_and()
+        while self.query and self.at_name("or"):
+            self.next()
+            self.expect("NAME", "vector")
+            self.expect("OP", "(")
+            default = float(self.expect("NUMBER").text)
+            self.expect("OP", ")")
+            left = OrVector(self.vector(left, "'or vector()' operand"), default)
+        return left
 
     def parse_and(self):
         left = self.parse_cmp()
@@ -315,7 +443,7 @@ class _Parser:
             return _Num(float(self.next().text))
         if self.at_op("("):
             self.next()
-            inner = self.parse_and()
+            inner = self.parse_or()
             self.expect("OP", ")")
             return inner
         if tok.kind != "NAME":
@@ -336,7 +464,9 @@ class _Parser:
             return Absent(child)
         if name == "histogram_quantile":
             return self.parse_histogram_quantile()
-        if name in ("increase", "avg_over_time"):
+        if name in ("increase", "avg_over_time") or (
+            self.query and name == "rate"
+        ):
             return self.parse_range_fn(name)
         return self.parse_selector()
 
@@ -360,8 +490,7 @@ class _Parser:
         self.expect("OP", "(")
         child = self.parse_and()
         self.expect("OP", ")")
-        if not isinstance(child, Expr):
-            raise PromQLError(f"{op}() takes a vector query")
+        child = self.vector(child, f"{op}() operand")
         if keys is None:
             return Avg(child) if op == "avg" else Aggregate(op, child)
         if op == "max":
@@ -373,6 +502,19 @@ class _Parser:
         self.expect("OP", "(")
         q_tok = self.expect("NUMBER")
         self.expect("OP", ",")
+        if self.query:
+            child = self.vector(
+                self.parse_and(), "histogram_quantile() operand"
+            )
+            self.expect("OP", ")")
+            if isinstance(child, Select) and child.name.endswith("_bucket"):
+                # the rule-subset shape: same canonical node either mode
+                return HistogramQuantile(
+                    float(q_tok.text),
+                    child.name[: -len("_bucket")],
+                    child.matchers,
+                )
+            return QHistogramQuantile(float(q_tok.text), child)
         sel = self.parse_selector()
         self.expect("OP", ")")
         if not sel.name.endswith("_bucket"):
@@ -393,21 +535,36 @@ class _Parser:
         self.expect("OP", "]")
         self.expect("OP", ")")
         if fn == "avg_over_time":
+            if not isinstance(sel, Select):
+                raise PromQLError(
+                    "avg_over_time() needs equality matchers only (the "
+                    f"closed loop evaluates it): {self.text!r}"
+                )
             return AvgOverTime(sel.name, window, sel.matchers)
+        if fn == "rate":
+            return Rate(sel, window)
+        if isinstance(sel, QSelect):
+            # non-equality matchers can't be the burn idiom's counter halves
+            return Increase(sel, window)
         return _Increase(sel.name, sel.matchers, window)
 
-    def parse_selector(self) -> Select:
+    def parse_selector(self):
         name = self.expect("NAME").text
         matchers: dict[str, str] = {}
+        triples: list[tuple[str, str, str]] = []
         if self.at_op("{"):
             self.next()
             while not self.at_op("}"):
                 key = self.expect("NAME").text
-                self.expect("OP", "=")
+                if self.query and self.at_op("!=", "=~", "!~"):
+                    op = self.next().text
+                else:
+                    self.expect("OP", "=")
+                    op = "="
                 raw = self.expect("STRING").text
-                matchers[key] = (
-                    raw[1:-1].replace('\\"', '"').replace("\\\\", "\\")
-                )
+                value = raw[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+                matchers[key] = value
+                triples.append((key, op, value))
                 if self.at_op(","):
                     self.next()
                 elif not self.at_op("}"):
@@ -416,6 +573,8 @@ class _Parser:
                         f"expected ',' or '}}' in matchers at {tok.pos}"
                     )
             self.expect("OP", "}")
+        if any(op != "=" for _, op, _ in triples):
+            return QSelect(name, tuple(triples))
         return Select(name, matchers)
 
     def parse_label_list(self) -> tuple[str, ...]:
@@ -437,3 +596,18 @@ def parse(text: str) -> Expr:
     equality), and for every string ``s`` in a generated manifest,
     ``parse(s).promql() == s``."""
     return _Parser(text).parse()
+
+
+def parse_query(text: str) -> Expr:
+    """Compile one DASHBOARD PromQL string: the rule subset plus the
+    query-only constructs Grafana panels use (``rate()``, bare
+    ``increase()``, ``!=``/``=~``/``!~`` matchers, ``or vector(N)``,
+    ``histogram_quantile`` over a general bucket expression).
+
+    Every rule-subset string parses identically under both entry points
+    (the extra grammar is strictly additive), so a dashboard panel that
+    graphs a recorded series shares its AST with the rule registry.  The
+    dashboard parity lint requires ``parse_query(s).promql() == s`` for
+    every panel target — the dashboard generator must author canonical
+    renderings, the same discipline the rule manifest already follows."""
+    return _Parser(text, query=True).parse()

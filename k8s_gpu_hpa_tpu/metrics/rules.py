@@ -679,6 +679,16 @@ class RecordingRule:
             attrs = {"samples_out": count}
             if staleness is not None:
                 attrs["staleness_seconds"] = staleness
+            if reads:
+                # storage tiers the reads were served from (r[5]: "raw" or a
+                # rollup label like "5m") — lineage stays honest across tiers
+                tier_counts: dict[str, int] = {}
+                for r in reads:
+                    tier = r[5]
+                    tier_counts[tier] = tier_counts.get(tier, 0) + 1
+                attrs["tiers"] = ",".join(
+                    f"{t}:{n}" for t, n in sorted(tier_counts.items())
+                )
             tracer.close(span, links, **attrs)
         return count
 

@@ -45,6 +45,17 @@ whole aged-out chunks from the front.  Cached last-point scalars keep the
 into numpy arrays (bounded cache) and ``searchsorted``.  Snapshots carry the
 compressed blobs verbatim (format 2); format-1 snapshots from the
 pre-columnar engine still replay, re-encoded point by point.
+
+Long-horizon rollups (ISSUE 8): constructed with a
+:class:`~k8s_gpu_hpa_tpu.metrics.downsample.DownsamplePolicy`, sealed raw
+chunks aging past the policy horizon compact into per-tier rollup rows
+(count, sum, min, max, last) from the append path — and a chunk evicted by
+raw retention before reaching the horizon is ingested on its way out, so
+rollups never lose data to a short raw window.  ``rollup_range_avg`` serves
+tier-aligned range queries straight from the rollups (the planner's tier
+selection), ``range_avg_bucketed`` is its raw twin for bit-identity checks,
+and format-3 snapshots carry the rollup state verbatim next to the raw
+columns.
 """
 
 from __future__ import annotations
@@ -58,6 +69,16 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
+from k8s_gpu_hpa_tpu.metrics.downsample import (
+    DownsamplePolicy,
+    Downsampler,
+    fold_avg as _ds_fold_avg,
+    newest_bucket_in_window as _ds_newest_bucket,
+    raw_fold as _ds_raw_fold,
+    restore_rollup as _ds_restore_rollup,
+    serialize_rollup as _ds_serialize_rollup,
+    tier_segments as _ds_tier_segments,
+)
 from k8s_gpu_hpa_tpu.metrics.exposition import parse_text
 from k8s_gpu_hpa_tpu.metrics.gorilla import (
     GorillaChunk,
@@ -71,9 +92,12 @@ from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 LabelSet = tuple[tuple[str, str], ...]
 
 #: WAL snapshot payload format written by ``TimeSeriesDB.snapshot``.
-#: 1 = pre-columnar (per-point JSON triples); 2 = Gorilla chunk blobs.
-#: ``recover`` negotiates: a payload without a ``format`` field is v1.
-SNAPSHOT_FORMAT = 2
+#: 1 = pre-columnar (per-point JSON triples); 2 = Gorilla chunk blobs;
+#: 3 = 2 + per-series downsampled rollup state (metrics/downsample.py).
+#: ``recover`` negotiates: a payload without a ``format`` field is v1, and
+#: a v1/v2 payload recovered into a downsampling DB rebuilds its rollups
+#: from the installed raw chunks.
+SNAPSHOT_FORMAT = 3
 
 
 class _Series:
@@ -101,12 +125,15 @@ class _Series:
     """
 
     __slots__ = ("labels", "chunks", "enc", "head_origins", "head_first_ts",
-                 "last_ts", "last_val", "last_origin", "_head_cache")
+                 "last_ts", "last_val", "last_origin", "_head_cache", "rollup")
 
     def __init__(self, labels: LabelSet):
         self.labels = labels
         self.chunks: list[GorillaChunk] = []
         self.enc = GorillaEncoder()
+        #: SeriesRollups (metrics/downsample.py) once the owning DB's
+        #: downsampler has touched this series, else None
+        self.rollup = None
         #: origin span ids parallel to the head stream (obs/trace.py), or
         #: None while every head point is untraced (the common case)
         self.head_origins: list[int | None] | None = None
@@ -295,6 +322,7 @@ class TimeSeriesDB:
         wal=None,
         snapshot_every: int = 8192,
         chunk_size: int = 64,
+        downsample: DownsamplePolicy | None = None,
     ):
         self.clock = clock or SystemClock()
         self.lookback = lookback
@@ -325,6 +353,12 @@ class TimeSeriesDB:
         #: points per series (Prometheus defaults to 120; 64 keeps retention
         #: granularity fine enough for the 300 s default window)
         self.chunk_size = chunk_size
+        #: downsampling compaction engine (metrics/downsample.py), or None
+        #: for a raw-only store (the default; rollups cost ingest work and
+        #: only long-horizon surfaces read them)
+        self._downsampler = (
+            None if downsample is None else Downsampler(downsample, chunk_size)
+        )
         #: chunks currently holding a decoded cache, eviction order (each
         #: chunk appears at most once: it joins on decode, leaves on evict)
         self._decoded_chunks: deque[GorillaChunk] = deque()
@@ -340,7 +374,9 @@ class TimeSeriesDB:
         self._total_points = 0
         self._appends_since_gc = 0
         #: active read-capture sink (see begin_capture), else None
-        self._capture: list[tuple[str, LabelSet, float, float, int | None]] | None = None
+        self._capture: (
+            list[tuple[str, LabelSet, float, float, int | None, str]] | None
+        ) = None
         #: metrics.wal.WriteAheadLog, or None for the memory-only default;
         #: every accepted append is logged, and a snapshot is cut every
         #: ``snapshot_every`` logged records (bounding restart replay)
@@ -407,9 +443,30 @@ class TimeSeriesDB:
             series.seal_head()
         dropped = 0
         chunks = series.chunks
+        ds = self._downsampler
+        if ds is None:
+            roll = None
+        else:
+            # rollup compaction: ingest sealed chunks aged past the horizon
+            # (guard is one list probe + compare per append; the ingest
+            # itself amortizes to ~2 bucket updates per appended point)
+            roll = series.rollup
+            if roll is None:
+                roll = series.rollup = ds.new_state()
+            k = roll.ingested
+            if k < len(chunks) and chunks[k].last_ts < ts - ds.horizon:
+                ds.ingest_pending(roll, chunks, ts)
         if chunks:
             cutoff = ts - self.retention
             while chunks and chunks[0].last_ts < cutoff:
+                if roll is not None:
+                    if roll.ingested:
+                        roll.ingested -= 1
+                    else:
+                        # retention compaction: a chunk evicted before aging
+                        # past the horizon is ingested on its way out, so a
+                        # raw window shorter than the horizon loses nothing
+                        ds.ingest_chunk(roll, chunks[0])
                 dropped += chunks.pop(0).count
         self._total_points += 1 - dropped
         self._versions[name] = self._versions.get(name, 0) + 1
@@ -488,33 +545,36 @@ class TimeSeriesDB:
         for name, by_name in self._data.items():
             for series in by_name.values():
                 enc = series.enc
-                series_out.append(
-                    {
-                        "name": name,
-                        "labels": list(series.labels),
-                        "chunks": [
-                            [
-                                c.count,
-                                b64(c.ts_blob).decode("ascii"),
-                                b64(c.val_blob).decode("ascii"),
-                                None if c.origins is None else list(c.origins),
-                                c.first_ts,
-                                c.last_ts,
-                                c.ts_mode,
-                            ]
-                            for c in series.chunks
-                        ],
-                        "head": [
-                            enc.count,
-                            b64(bytes(enc.ts_buf)).decode("ascii"),
-                            b64(bytes(enc.val_buf)).decode("ascii"),
-                            None
-                            if series.head_origins is None
-                            else list(series.head_origins),
-                            enc.ts_mode,
-                        ],
-                    }
-                )
+                entry = {
+                    "name": name,
+                    "labels": list(series.labels),
+                    "chunks": [
+                        [
+                            c.count,
+                            b64(c.ts_blob).decode("ascii"),
+                            b64(c.val_blob).decode("ascii"),
+                            None if c.origins is None else list(c.origins),
+                            c.first_ts,
+                            c.last_ts,
+                            c.ts_mode,
+                        ]
+                        for c in series.chunks
+                    ],
+                    "head": [
+                        enc.count,
+                        b64(bytes(enc.ts_buf)).decode("ascii"),
+                        b64(bytes(enc.val_buf)).decode("ascii"),
+                        None
+                        if series.head_origins is None
+                        else list(series.head_origins),
+                        enc.ts_mode,
+                    ],
+                }
+                if series.rollup is not None:
+                    # format 3: rollup columns travel verbatim next to the
+                    # raw ones, so compaction lineage survives the restart
+                    entry["rollup"] = _ds_serialize_rollup(series.rollup, b64)
+                series_out.append(entry)
         payload = {
             "format": SNAPSHOT_FORMAT,
             "at": self.clock.now(),
@@ -531,6 +591,13 @@ class TimeSeriesDB:
                 for (name, labels), ex in self._exemplars.items()
             ],
         }
+        ds = self._downsampler
+        if ds is not None:
+            payload["downsample"] = {
+                "steps": list(ds.steps),
+                "horizon": ds.horizon,
+                "retention": ds.retention,
+            }
         self.wal.write_snapshot(payload)
         self._wal_records_since_snapshot = 0
 
@@ -543,6 +610,7 @@ class TimeSeriesDB:
         retention: float | None = None,
         snapshot_every: int = 8192,
         chunk_size: int = 64,
+        downsample: DownsamplePolicy | None = None,
     ) -> "TimeSeriesDB":
         """Rebuild a TSDB from its durable state: restore the snapshot, then
         replay the WAL tail in append order.  Replay goes through ``append``
@@ -558,20 +626,36 @@ class TimeSeriesDB:
         mid-stream); a payload without a ``format`` field is a v1
         (pre-columnar) snapshot whose per-point triples re-encode through
         the columnar path — old WALs replay into the new engine unchanged.
+        Format 3 adds per-series rollup state, restored verbatim when the
+        recovered DB downsamples; v1/v2 payloads (or fresh policies) rebuild
+        rollups by re-ingesting the installed raw chunks as of the snapshot
+        cut, and ``downsample=None`` adopts the policy recorded in the
+        payload so a restart keeps compacting without being re-told how.
 
         The recovered instance takes ownership of ``wal`` and stamps
         ``last_recovery`` with replay stats (the chaos RecoveryReports read
         ``replay gap`` = recovery wall position minus newest replayed ts)."""
         payload, tail = wal.read()
+        if downsample is None and payload is not None:
+            ds_payload = payload.get("downsample")
+            if ds_payload is not None:
+                downsample = DownsamplePolicy(
+                    tuple(ds_payload["steps"]),
+                    ds_payload["horizon"],
+                    ds_payload["retention"],
+                )
         db = cls(
             clock,
             lookback=(payload or {}).get("lookback", lookback),
             retention=(payload or {}).get("retention", retention),
             snapshot_every=snapshot_every,
             chunk_size=chunk_size,
+            downsample=downsample,
         )
         newest_ts = -math.inf
         recovered_points = 0
+        rollup_restored = 0
+        rollup_rebuilt = 0
         if payload is not None:
             fmt = payload.get("format", 1)
             b64 = base64.b64decode
@@ -629,6 +713,22 @@ class TimeSeriesDB:
                             series.seal_head()
                 if series.last_ts == -math.inf:
                     continue  # empty series: nothing to install
+                ds = db._downsampler
+                if ds is not None:
+                    roll_payload = entry.get("rollup") if fmt >= 3 else None
+                    if roll_payload is not None:
+                        series.rollup = _ds_restore_rollup(ds, roll_payload, b64)
+                        rollup_restored += 1
+                    elif series.chunks:
+                        # pre-rollup snapshot (or rollups freshly enabled):
+                        # rebuild by re-ingesting aged raw chunks, aged
+                        # against the series' own newest timestamp — the
+                        # same "now" a live compactor would have used on its
+                        # last append (the snapshot's wall ``at`` can be a
+                        # different clock domain than virtual-time data)
+                        roll = series.rollup = ds.new_state()
+                        ds.ingest_pending(roll, series.chunks, series.last_ts)
+                        rollup_rebuilt += 1
                 db._data.setdefault(name, {})[labels] = series
                 index = db._index.setdefault(name, {})
                 for pair in labels:
@@ -697,6 +797,9 @@ class TimeSeriesDB:
             "replay_gap_seconds": (
                 max(0.0, now - newest_ts) if newest_ts != -math.inf else None
             ),
+            "rollup_series_restored": rollup_restored,
+            "rollup_series_rebuilt": rollup_rebuilt,
+            "rollup_enabled": db._downsampler is not None,
         }
         return db
 
@@ -711,9 +814,12 @@ class TimeSeriesDB:
     def begin_capture(self) -> None:
         self._capture = []
 
-    def end_capture(self) -> list[tuple[str, LabelSet, float, float, int | None]]:
-        """Stop capturing; returns (name, labels, ts, value, origin) per
-        point read since begin_capture."""
+    def end_capture(
+        self,
+    ) -> list[tuple[str, LabelSet, float, float, int | None, str]]:
+        """Stop capturing; returns (name, labels, ts, value, origin, tier)
+        per point read since begin_capture — ``tier`` names the storage the
+        read was served from (``"raw"``, or a rollup label like ``"5m"``)."""
         captured, self._capture = self._capture or [], None
         return captured
 
@@ -803,7 +909,7 @@ class TimeSeriesDB:
                 if value != value or at - pt_ts > lookback:
                     continue
             if capture is not None:
-                capture.append((name, series.labels, pt_ts, value, origin))
+                capture.append((name, series.labels, pt_ts, value, origin, "raw"))
             out.append(Sample(value, series.labels))
         return out
 
@@ -817,9 +923,14 @@ class TimeSeriesDB:
         stats=None,
     ) -> list[Sample]:
         """``avg_over_time(name{matchers}[window])``: per-series mean over
-        points in ``[at - window_s, at]``, NaN staleness markers excluded
+        points in ``(at - window_s, at]``, NaN staleness markers excluded
         (range-vector semantics: markers are not samples, and lookback does
-        not apply).
+        not apply).  The window is left-open — a point exactly at
+        ``at - window_s`` is OUT — matching Prometheus 3 range selectors
+        and, critically, the rollup tiers' bucket grammar: a tier-served
+        read (:meth:`rollup_range_avg`) covers whole left-open buckets, so
+        only this boundary convention lets tier selection substitute for
+        this method bit-exactly.
 
         Both execution paths produce **bit-identical** floats by sharing one
         accumulation shape: each segment (sealed chunk, then head) reduces to
@@ -842,9 +953,9 @@ class TimeSeriesDB:
             n = 0
             total = 0.0
             for chunk in series.chunks:
-                if chunk.last_ts < start or chunk.first_ts > at:
+                if chunk.last_ts <= start or chunk.first_ts > at:
                     continue
-                if use_summaries and chunk.first_ts >= start:
+                if use_summaries and chunk.first_ts > start:
                     # sorted columns: last_ts <= at is implied unless the
                     # query cuts mid-chunk, checked explicitly
                     if chunk.last_ts <= at:
@@ -858,7 +969,7 @@ class TimeSeriesDB:
                 if stats is not None:
                     stats.fallback += 1
                 ts_arr, val_arr = chunk_arrays(chunk)
-                lo = int(ts_arr.searchsorted(start, side="left"))
+                lo = int(ts_arr.searchsorted(start, side="right"))
                 hi = int(ts_arr.searchsorted(at, side="right"))
                 sub_n = 0
                 sub = 0.0
@@ -871,11 +982,11 @@ class TimeSeriesDB:
                     total += sub
             if (
                 series.enc.count
-                and series.last_ts >= start
+                and series.last_ts > start
                 and series.head_first_ts <= at
             ):
                 ts_arr, val_arr = series.head_arrays()
-                lo = int(ts_arr.searchsorted(start, side="left"))
+                lo = int(ts_arr.searchsorted(start, side="right"))
                 hi = int(ts_arr.searchsorted(at, side="right"))
                 sub_n = 0
                 sub = 0.0
@@ -892,7 +1003,7 @@ class TimeSeriesDB:
                 point = self._newest_in_window(series, start, at)
                 if point is not None:
                     capture.append(
-                        (name, series.labels, point[0], point[1], point[2])
+                        (name, series.labels, point[0], point[1], point[2], "raw")
                     )
             out.append(Sample(total / n, series.labels))
         return out
@@ -900,13 +1011,13 @@ class TimeSeriesDB:
     def _newest_in_window(
         self, series: _Series, start: float, at: float
     ) -> tuple[float, float, int | None] | None:
-        """Newest non-NaN point with ``start <= ts <= at`` — the capture
+        """Newest non-NaN point with ``start < ts <= at`` — the capture
         representative of a range read (head first, then chunks newest-first)."""
         if series.enc.count and series.head_first_ts <= at:
             ts_arr, val_arr = series.head_arrays()
             hi = int(ts_arr.searchsorted(at, side="right"))
             for i in range(hi - 1, -1, -1):
-                if float(ts_arr[i]) < start:
+                if float(ts_arr[i]) <= start:
                     break
                 v = float(val_arr[i])
                 if v == v:
@@ -919,12 +1030,12 @@ class TimeSeriesDB:
         for chunk in reversed(series.chunks):
             if chunk.first_ts > at:
                 continue
-            if chunk.last_ts < start:
+            if chunk.last_ts <= start:
                 break
             ts_arr, val_arr = self._chunk_arrays(chunk)
             hi = int(ts_arr.searchsorted(at, side="right"))
             for i in range(hi - 1, -1, -1):
-                if float(ts_arr[i]) < start:
+                if float(ts_arr[i]) <= start:
                     break
                 v = float(val_arr[i])
                 if v == v:
@@ -935,6 +1046,199 @@ class TimeSeriesDB:
                         None if origins is None else origins[i],
                     )
         return None
+
+    # ---- downsampled rollup tiers (metrics/downsample.py) ------------------
+
+    @property
+    def rollup_steps(self) -> tuple[float, ...]:
+        """Configured tier resolutions, finest first; empty when raw-only.
+        The planner's tier-selection menu."""
+        ds = self._downsampler
+        return () if ds is None else ds.steps
+
+    @property
+    def downsample_policy(self) -> DownsamplePolicy | None:
+        ds = self._downsampler
+        return None if ds is None else ds.policy
+
+    def rollup_range_avg(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        window_s: float = 0.0,
+        at: float | None = None,
+        step: float | None = None,
+        stats=None,
+    ) -> list[Sample] | None:
+        """``avg_over_time`` served from the ``step`` rollup tier, or None
+        when the tier cannot serve it faithfully (no downsampler, unknown
+        step, or any matching series not compacted through ``at`` yet) —
+        the caller falls back to :meth:`range_avg`.
+
+        The window is the tier-aligned ``(at - window_s, at]``; bucket rows
+        fold through the shared segment shape (full rollup chunks via their
+        seal-time column sums, boundary chunks and the head decoded), so the
+        result is bit-identical to :meth:`range_avg_bucketed` — the raw twin
+        — by construction.  Capture records the newest in-window bucket per
+        series with the tier's label (``"5m"``/``"1h"``), origin None:
+        rollups aggregate many origins, and lineage stays honest by naming
+        the tier instead of inventing a span."""
+        series_list = self.series_for(name, matchers)
+        if not series_list:
+            return []
+        ds = self._downsampler
+        if ds is None:
+            return None
+        ti = ds.tier_index(step)
+        if ti is None:
+            return None
+        at = self.clock.now() if at is None else at
+        # tier alignment is enforced here, not trusted from the caller: an
+        # unaligned window cuts buckets mid-span and silently diverges from
+        # raw semantics, so it must fall back instead
+        if window_s < step or window_s % step != 0.0 or at % step != 0.0:
+            return None
+        start = at - window_s
+        label = ds.labels[ti]
+        capture = self._capture
+        chunk_arrays = self._chunk_arrays
+        out: list[Sample] = []
+        for series in series_list:
+            roll = series.rollup
+            tier = None if roll is None else roll.tiers[ti]
+            if tier is None or tier.covered_through < at:
+                # a series born after the window contributes nothing either
+                # way; anything else forces the whole query back to raw
+                if series.chunks:
+                    first_ts = series.chunks[0].first_ts
+                elif series.enc.count:
+                    first_ts = series.head_first_ts
+                else:
+                    first_ts = math.inf
+                if first_ts > at:
+                    continue
+                return None
+            n, total = _ds_fold_avg(
+                _ds_tier_segments(tier, chunk_arrays), start, at, stats
+            )
+            if n == 0:
+                continue
+            if capture is not None:
+                bucket = _ds_newest_bucket(tier, start, at, chunk_arrays)
+                if bucket is not None:
+                    capture.append(
+                        (name, series.labels, bucket[0], bucket[5], None, label)
+                    )
+            out.append(Sample(total / n, series.labels))
+        if stats is not None:
+            stats.rollup_reads[label] = stats.rollup_reads.get(label, 0) + 1
+        return out
+
+    def range_avg_bucketed(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        window_s: float = 0.0,
+        at: float | None = None,
+        step: float | None = None,
+    ) -> list[Sample]:
+        """The raw twin of :meth:`rollup_range_avg`: regenerate ``step``
+        bucket rows from the retained RAW points and run the identical
+        segment fold over ``(at - window_s, at]``.  Exists for the
+        differential gates (bench, doctor, tests): where raw retention still
+        covers the span, its floats must equal the rollup read's bit for
+        bit.  No capture — this is a verification surface, not a query
+        path."""
+        if step is None or step <= 0:
+            raise ValueError(f"range_avg_bucketed needs a positive step: {step}")
+        at = self.clock.now() if at is None else at
+        start = at - window_s
+        chunk_arrays = self._chunk_arrays
+        out: list[Sample] = []
+        for series in self.series_for(name, matchers):
+            n, total = _ds_raw_fold(
+                series, step, self.chunk_size, start, at, chunk_arrays
+            )
+            if n == 0:
+                continue
+            out.append(Sample(total / n, series.labels))
+        return out
+
+    def rollup_rows(
+        self,
+        name: str,
+        matchers: dict[str, str] | None = None,
+        start: float = -math.inf,
+        at: float = math.inf,
+        step: float | None = None,
+    ) -> list[tuple[LabelSet, list[tuple]]]:
+        """Stored rollup rows per matching series — ``(labels, rows)`` with
+        each row ``(end, count, sum, min, max, last)`` and end in
+        ``(start, at]``.  The flight recorder's bulk read; empty when the
+        tier is absent."""
+        ds = self._downsampler
+        if ds is None:
+            return []
+        ti = ds.tier_index(step)
+        if ti is None:
+            return []
+        chunk_arrays = self._chunk_arrays
+        out = []
+        for series in self.series_for(name, matchers):
+            roll = series.rollup
+            if roll is None:
+                continue
+            rows: list[tuple] = []
+            for seg in _ds_tier_segments(roll.tiers[ti], chunk_arrays):
+                if seg.last_ts <= start or seg.first_ts > at:
+                    continue
+                ends, cols = seg.cols()
+                for i in range(len(ends)):
+                    end = float(ends[i])
+                    if end <= start or end > at:
+                        continue
+                    rows.append((end,) + tuple(float(c[i]) for c in cols))
+            if rows:
+                out.append((series.labels, rows))
+        return out
+
+    def rollup_storage_stats(self) -> dict:
+        """Rollup-plane accounting for the bench/doctor surface: per-tier
+        chunk/bucket/byte totals plus the downsampler's lifetime counters."""
+        ds = self._downsampler
+        if ds is None:
+            return {"enabled": False, "tiers": {}}
+        per_tier: dict[str, dict] = {
+            label: {"series": 0, "chunks": 0, "buckets": 0, "bytes": 0}
+            for label in ds.labels
+        }
+        total_bytes = 0
+        for by_name in self._data.values():
+            for series in by_name.values():
+                roll = series.rollup
+                if roll is None:
+                    continue
+                for label, tier in zip(ds.labels, roll.tiers):
+                    buckets = tier.nbuckets()
+                    if not buckets and not tier.chunks:
+                        continue
+                    entry = per_tier[label]
+                    entry["series"] += 1
+                    entry["chunks"] += len(tier.chunks)
+                    entry["buckets"] += buckets
+                    nbytes = tier.nbytes()
+                    entry["bytes"] += nbytes
+                    total_bytes += nbytes
+        return {
+            "enabled": True,
+            "tiers": per_tier,
+            "rollup_bytes": total_bytes,
+            "ingested_points": ds.ingested_points,
+            "ingested_chunks": ds.ingested_chunks,
+            "ingested_bytes": ds.ingested_bytes,
+            "sealed_buckets": ds.sealed_buckets,
+            "dropped_buckets": ds.dropped_buckets,
+        }
 
     def _chunk_arrays(self, chunk: GorillaChunk):
         """Decoded (ts, values) arrays of a sealed chunk, cached on the

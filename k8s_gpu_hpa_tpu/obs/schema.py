@@ -53,7 +53,7 @@ SPAN_SCHEMA: dict[str, dict] = {
         "description": "one recording-rule evaluation; links to every scrape "
         "(or upstream rule_eval) whose points the expression read",
         "required": frozenset({"rule", "samples_out"}),
-        "optional": frozenset({"staleness_seconds"}),
+        "optional": frozenset({"staleness_seconds", "tiers"}),
         "link_kinds": frozenset({"scrape", "rule_eval"}),
     },
     "adapter_query": {

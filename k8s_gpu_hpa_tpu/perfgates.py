@@ -98,3 +98,38 @@ MAX_FLEET_QUERY_P95_MS = 3.0
 #: ingest floor across the whole plane (measured ~140-190k/s; dropping
 #: below 25k/s means the append hot path gained per-point overhead)
 MIN_APPENDS_PER_SEC = 25000.0
+
+# ---- downsample_bench: rollup tiers vs raw decode (ISSUE 8) -----------------
+
+#: the full rung ages a DAY of 10k-target fleet history (30 s cadence)
+#: through the 5m/1h compactor, then reads a 20 h tier-aligned fleet
+#: window ending at hour 22 both ways
+DOWNSAMPLE_BENCH_TARGETS = SIM_SCALE_10K_TARGETS
+DOWNSAMPLE_BENCH_SHARDS = SIM_SCALE_10K_SHARDS
+DOWNSAMPLE_BENCH_HORIZON_S = 86400.0
+DOWNSAMPLE_BENCH_INTERVAL_S = 30.0
+DOWNSAMPLE_BENCH_WINDOW_S = 72000.0
+DOWNSAMPLE_BENCH_AT_S = 79200.0
+#: rollup-tier fleet query vs the cold raw rescan of the same window
+#: (measured ~100x+; the tier silently falling back to raw lands at ~1x)
+MIN_ROLLUP_SPEEDUP = 5.0
+
+#: smoke keeps the full rung's 30 s cadence (the storage ratio is a
+#: statement about samples-per-bucket density, so thinning the cadence
+#: would fake it) but shrinks the span to 6 h and the fleet to 200
+DOWNSAMPLE_SMOKE_TARGETS = 200
+DOWNSAMPLE_SMOKE_SHARDS = 2
+DOWNSAMPLE_SMOKE_HORIZON_S = 21600.0
+DOWNSAMPLE_SMOKE_INTERVAL_S = 30.0
+#: 3 h window ending at hour 4 — aligned, and comfortably inside the
+#: compacted span (the compactor trails "now" by horizon + ~2 chunks)
+DOWNSAMPLE_SMOKE_WINDOW_S = 10800.0
+DOWNSAMPLE_SMOKE_AT_S = 14400.0
+#: fewer raw points per series shrinks the decode-avoidance margin
+DOWNSAMPLE_SMOKE_MIN_ROLLUP_SPEEDUP = 3.0
+
+#: rollup bytes for the aged span vs the 16-byte uncompressed cost of the
+#: raw samples they summarize (measured ~0.06: 5 Gorilla columns per
+#: bucket at 1/10-1/120 the sample count); a tier accidentally storing
+#: per-sample rows would land near 1.0
+MAX_ROLLUP_BYTES_RATIO = 0.1

@@ -256,6 +256,33 @@ def test_query_bench_rung_gates_identity_speedup_and_fastpath(monkeypatch):
     assert result["ok"] is True
 
 
+def test_downsample_bench_rung_gates_identity_speedup_and_storage(monkeypatch):
+    """The rollup-tier rung (ISSUE 8), exercised at smoke sizing
+    (TIME_SCALE != 1 path: 200 targets / 2 shards / 6 h at the full rung's
+    30 s cadence): the tier-aligned fleet read served from the 1h rollups
+    must be bit-identical to the raw bucketed twin, beat the cold raw
+    rescan by the smoke floor, keep rollup bytes within the storage budget
+    of the uncompressed samples they summarize, pass the randomized
+    differential, and actually route through the tier (rollup_reads)."""
+    monkeypatch.setattr(bench, "TIME_SCALE", 0.1)
+    result = bench.run_rung_downsample_bench()
+    assert result["mode"] == "virtual"
+    assert result["targets"] == 200 and result["shards"] == 2
+    assert result["identical"] is True
+    assert result["speedup"] >= result["speedup_floor"]
+    assert result["bytes_ratio"] <= result["bytes_ratio_budget"]
+    assert result["tier_selected"] is True
+    assert sum(result["rollup_reads"].values()) > 0
+    diff = result["differential"]
+    assert diff["windows_checked"] > 0
+    assert diff["fold_mismatches"] == 0 and diff["row_mismatches"] == 0
+    # both tiers must exist with sealed buckets — a 5m-only plane would
+    # still pass the speedup gate but the 1h flight-recorder view is gone
+    assert result["tiers"]["5m"]["buckets"] > 0
+    assert result["tiers"]["1h"]["buckets"] > 0
+    assert result["ok"] is True
+
+
 def test_sim_scale_10k_rung_gates_compression_query_and_ring(monkeypatch):
     """The sharded federation rung (ISSUE 6), exercised at smoke sizing
     (TIME_SCALE != 1 path: 2000 targets / 4 shards) so tier-1 stays fast —

@@ -150,8 +150,9 @@ def test_diagnose_stops_at_first_broken_joint():
 def test_diagnose_skips_absent_fetchers():
     results = diagnose(exporter_fetch=lambda: exposition())
     # L2 + L3 + L3 scrape health + L3 shard topology + L3 self-metrics
-    # + L3 histograms + L3 query planner + L4 + L5 + operator + alerts
-    assert [r.ok for r in results] == [True] * 11
+    # + L3 histograms + L3 query planner + L3 rollup tiers + L4 + L5
+    # + operator + alerts
+    assert [r.ok for r in results] == [True] * 12
     assert results[1].detail.startswith("skipped")
 
 
@@ -314,6 +315,112 @@ def test_diagnose_query_planner_probe_against_live_db():
     by_name = {r.name: r for r in results}
     assert by_name["L3 query planner"].ok, by_name["L3 query planner"].detail
     assert "planned==naive" in by_name["L3 query planner"].detail
+
+
+# ---- rollup tier probe (ISSUE 8) --------------------------------------------
+
+
+def _downsampled_db(hours: float = 6.0, series: int = 4):
+    import math
+
+    from k8s_gpu_hpa_tpu.metrics.downsample import DownsamplePolicy
+    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    clock = VirtualClock()
+    db = TimeSeriesDB(
+        clock, retention=(hours + 1.0) * 3600.0, downsample=DownsamplePolicy()
+    )
+    labels = [
+        tuple(sorted({"job": "probe", "instance": f"p-{i}"}.items()))
+        for i in range(series)
+    ]
+    ts = 0.0
+    for _ in range(int(hours * 3600.0 / 30.0)):
+        ts += 30.0
+        clock.advance(30.0)
+        for i, lab in enumerate(labels):
+            db.append(
+                "probe_metric", lab, 10.0 + i + round(math.sin(ts / 900.0), 2)
+            )
+    return db
+
+
+def test_check_downsampling_accepts_live_selfcheck():
+    from k8s_gpu_hpa_tpu.doctor import check_downsampling
+    from k8s_gpu_hpa_tpu.metrics.downsample import downsample_selfcheck
+
+    db = _downsampled_db()
+    doc = downsample_selfcheck(db, ["probe_metric"])
+    assert doc["enabled"] and doc["agree_all"]
+    assert doc["windows_served"] >= 2  # one aligned window per tier
+    assert all(e["buckets"] > 0 for e in doc["tiers"].values())
+    detail = check_downsampling(json.dumps(doc))
+    assert "rollup==raw twin" in detail
+    assert "5m" in detail and "1h" in detail
+
+
+def test_check_downsampling_rejects_raw_only_db():
+    from k8s_gpu_hpa_tpu.doctor import check_downsampling
+    from k8s_gpu_hpa_tpu.metrics.downsample import downsample_selfcheck
+    from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    db = TimeSeriesDB(VirtualClock(), retention=3600.0)
+    doc = downsample_selfcheck(db, ["probe_metric"])
+    assert not doc["enabled"]
+    with pytest.raises(AssertionError, match="no downsample policy"):
+        check_downsampling(json.dumps(doc))
+
+
+def test_check_downsampling_flags_empty_tier():
+    from k8s_gpu_hpa_tpu.doctor import check_downsampling
+    from k8s_gpu_hpa_tpu.metrics.downsample import downsample_selfcheck
+
+    # too young for any bucket to seal: the probe must say so, not pass
+    db = _downsampled_db(hours=0.05)
+    with pytest.raises(AssertionError, match="no sealed buckets"):
+        check_downsampling(json.dumps(downsample_selfcheck(db, ["probe_metric"])))
+
+
+def test_check_downsampling_flags_disagreement():
+    from k8s_gpu_hpa_tpu.doctor import check_downsampling
+    from k8s_gpu_hpa_tpu.metrics.downsample import downsample_selfcheck
+
+    db = _downsampled_db()
+    doc = downsample_selfcheck(db, ["probe_metric"])
+    doc["agreement"][0]["agree"] = False
+    doc["agree_all"] = False
+    with pytest.raises(AssertionError, match="DISAGREES.*probe_metric@5m"):
+        check_downsampling(json.dumps(doc))
+
+
+def test_check_downsampling_flags_no_verifiable_overlap():
+    from k8s_gpu_hpa_tpu.doctor import check_downsampling
+    from k8s_gpu_hpa_tpu.metrics.downsample import downsample_selfcheck
+
+    db = _downsampled_db()
+    doc = downsample_selfcheck(db, ["probe_metric"])
+    doc["agreement"] = []
+    doc["windows_served"] = 0
+    with pytest.raises(AssertionError, match="differentially verified"):
+        check_downsampling(json.dumps(doc))
+
+
+def test_diagnose_downsample_probe_against_live_db():
+    """The probe end-to-end, live-DB idiom: selfcheck payload from a real
+    compacted TSDB through diagnose, not a canned dict."""
+    from k8s_gpu_hpa_tpu.metrics.downsample import downsample_selfcheck
+
+    db = _downsampled_db()
+    payload = json.dumps(downsample_selfcheck(db, ["probe_metric"]))
+    results = diagnose(downsample_fetch=lambda: payload)
+    by_name = {r.name: r for r in results}
+    assert by_name["L3 rollup tiers"].ok, by_name["L3 rollup tiers"].detail
+    assert "rollup==raw twin" in by_name["L3 rollup tiers"].detail
+    # optional probe: skipped cleanly when no fetcher is given
+    results = diagnose()
+    assert "skipped" in {r.name: r for r in results}["L3 rollup tiers"].detail
 
 
 # ---- quantum operator probe -------------------------------------------------

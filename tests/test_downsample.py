@@ -1,0 +1,358 @@
+"""Downsampled rollup tiers (metrics/downsample.py): the unit contracts.
+
+The compaction layer turns sealed raw Gorilla chunks into 5m/1h rollup
+rows of ``(count, sum, min, max, last)``, end-stamped per bucket.  What
+this file pins:
+
+- bucket semantics: END stamping, left-open right-closed membership (a
+  point exactly on a boundary closes its bucket), NaN-only buckets emit
+  no row but coverage still advances past them;
+- the **bit-identity twin**: a rollup-served ``avg_over_time`` on a
+  tier-aligned window equals ``range_avg_bucketed`` — the same fold run
+  over the retained raw points — in float bits, across randomized
+  layouts;
+- tier selection in the planner: coarsest aligned tier wins, unaligned
+  windows/instants silently stay raw, and a series not compacted
+  through the evaluation time forces a counted raw fallback — "almost
+  served from rollups" is never "approximately right";
+- both compaction triggers: horizon aging on the append path, and
+  compact-on-evict when raw retention is shorter than the horizon;
+- rollup retention trimming, storage accounting, and the federation
+  fan-out staying bit-exact across shards.
+
+Restart-boundary coverage (format-3 snapshots, v2 rebuild, kill at any
+byte) lives in tests/test_recovery.py; the economics gate (speedup /
+bytes ratio) is the bench's ``downsample_bench`` rung.
+"""
+
+import math
+import random
+
+import pytest
+
+from k8s_gpu_hpa_tpu.control.scale_harness import _vectors_identical
+from k8s_gpu_hpa_tpu.metrics.downsample import (
+    DownsamplePolicy,
+    bucket_end,
+    tier_label,
+)
+from k8s_gpu_hpa_tpu.metrics.federation import FederatedTSDB
+from k8s_gpu_hpa_tpu.metrics.planner import QueryPlanner
+from k8s_gpu_hpa_tpu.metrics.rules import AvgOverTime
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def lbl(**kw):
+    return tuple(sorted(kw.items()))
+
+
+#: tiers sized so a few hundred 5s appends compact: 1m/5m buckets, raw
+#: chunks aged 2 minutes past the newest append get ingested
+POLICY = DownsamplePolicy(steps=(60.0, 300.0), horizon=120.0)
+
+
+def _db(policy=POLICY, chunk_size=4, lookback=300.0, retention=10**9):
+    return TimeSeriesDB(
+        VirtualClock(),
+        lookback=lookback,
+        retention=retention,
+        chunk_size=chunk_size,
+        downsample=policy,
+    )
+
+
+def _pure_fold(points, step):
+    """The bucket rows a straight pass over ``(ts, value)`` pairs produces,
+    in append order — the oracle the storage layer must reproduce bit for
+    bit.  NaN contributes nothing; a bucket with only NaN emits no row."""
+    buckets: dict[float, list] = {}
+    for ts, v in points:
+        end = math.ceil(ts / step) * step
+        b = buckets.setdefault(end, [0, 0.0, math.inf, -math.inf, math.nan])
+        if v == v:
+            b[0] += 1
+            b[1] += v
+            b[2] = min(b[2], v)
+            b[3] = max(b[3], v)
+            b[4] = v
+    return {end: tuple(b) for end, b in buckets.items() if b[0]}
+
+
+def _pairs(vec):
+    return sorted((s.labels, s.value) for s in vec)
+
+
+# ---------------------------------------------------------------------------
+# policy & bucket grammar
+
+
+def test_policy_validation_rejects_misconfiguration():
+    with pytest.raises(ValueError):
+        DownsamplePolicy(steps=())
+    with pytest.raises(ValueError):
+        DownsamplePolicy(steps=(300.0, 60.0))  # must ascend
+    with pytest.raises(ValueError):
+        DownsamplePolicy(steps=(0.0,))
+    with pytest.raises(ValueError):
+        DownsamplePolicy(horizon=0.0)
+    with pytest.raises(ValueError):
+        DownsamplePolicy(retention=600.0)  # shorter than the 1h tier
+
+
+def test_tier_label_and_bucket_end_semantics():
+    assert tier_label(300.0) == "5m"
+    assert tier_label(3600.0) == "1h"
+    assert tier_label(7200.0) == "2h"
+    assert tier_label(45.0) == "45s"
+    # left-open right-closed: a boundary point closes its bucket
+    assert bucket_end(60.0, 60.0) == 60.0
+    assert bucket_end(60.0001, 60.0) == 120.0
+    assert bucket_end(59.9, 60.0) == 60.0
+
+
+# ---------------------------------------------------------------------------
+# bucket semantics on a live DB
+
+
+def test_rollup_rows_match_a_pure_python_fold():
+    db = _db()
+    labels = lbl(pod="p0")
+    points = []
+    for i in range(400):
+        ts = 5.0 * (i + 1)
+        v = (i % 13) * 1.5 - 3.0
+        points.append((ts, v))
+        db.append("m", labels, v, ts=ts)
+    for ti, step in enumerate(POLICY.steps):
+        tier = db._data["m"][labels].rollup.tiers[ti]
+        assert tier.covered_through > 0
+        stored = {
+            row[0]: row[1:]
+            for _, rows in db.rollup_rows("m", step=step)
+            for row in rows
+        }
+        expected = _pure_fold(points, step)
+        assert stored == {
+            end: row for end, row in expected.items()
+            if end <= tier.covered_through
+        }, f"tier {tier_label(step)}"
+    # 5s cadence: ts=60.0 lands IN the bucket ending 60.0, so (0, 60] holds
+    # twelve points — the boundary point closes the bucket, not opens the next
+    assert _pure_fold(points, 60.0)[60.0][0] == 12
+
+
+def test_nan_only_bucket_drops_row_but_advances_coverage():
+    db = _db(policy=DownsamplePolicy(steps=(60.0,), horizon=120.0))
+    labels = lbl(pod="p0")
+    for i in range(60):
+        ts = 10.0 * (i + 1)
+        v = float("nan") if 60.0 < ts <= 120.0 else float(i)
+        db.append("m", labels, v, ts=ts)
+    tier = db._data["m"][labels].rollup.tiers[0]
+    assert tier.covered_through >= 180.0
+    ends = {row[0] for _, rows in db.rollup_rows("m", step=60.0) for row in rows}
+    assert 60.0 in ends and 180.0 in ends
+    assert 120.0 not in ends  # all-NaN bucket: no row, coverage moved past it
+    vec = db.rollup_range_avg("m", None, 180.0, 180.0, 60.0)
+    assert vec is not None
+    assert _pairs(vec) == _pairs(
+        db.range_avg_bucketed("m", None, 180.0, 180.0, step=60.0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# the raw twin: bit-identity on tier-aligned windows
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_rollup_read_is_bit_identical_to_the_raw_twin(seed):
+    rng = random.Random(seed)
+    db = _db()
+    pods = [f"p{i}" for i in range(rng.randint(2, 5))]
+    ticks = 400
+    for i in range(ticks):
+        ts = 5.0 * (i + 1)
+        for pod in pods:
+            # NaN staleness markers sprinkled in, but the tail stays live so
+            # no series is marker-ended when the queries run
+            live = i >= ticks - 30 or rng.random() >= 0.05
+            v = rng.uniform(0.0, 100.0) if live else float("nan")
+            db.append("m", lbl(pod=pod), v, ts=ts)
+    at = 1800.0
+    for step in (60.0, 300.0):
+        for window in (step, 600.0, 1500.0):
+            vec = db.rollup_range_avg("m", None, window, at, step)
+            assert vec is not None and len(vec) == len(pods)
+            twin = db.range_avg_bucketed("m", None, window, at, step=step)
+            assert _pairs(vec) == _pairs(twin), (
+                f"seed={seed} step={step} window={window}"
+            )
+
+
+def test_rollup_read_falls_back_when_it_cannot_be_faithful():
+    db = _db()
+    labels = lbl(pod="p0")
+    for i in range(400):
+        db.append("m", labels, float(i % 7), ts=5.0 * (i + 1))
+    at = 1800.0
+    assert db.rollup_range_avg("m", None, 630.0, at, 300.0) is None  # window unaligned
+    assert db.rollup_range_avg("m", None, 600.0, at + 7.0, 300.0) is None  # at unaligned
+    assert db.rollup_range_avg("m", None, 60.0, at, 300.0) is None  # window < step
+    assert db.rollup_range_avg("m", None, 600.0, at, 120.0) is None  # unknown tier
+    assert db.rollup_range_avg("ghost", None, 600.0, at, 300.0) == []  # no series
+    raw_only = TimeSeriesDB(VirtualClock(), retention=10**9)
+    raw_only.append("m", labels, 1.0, ts=5.0)
+    assert raw_only.rollup_range_avg("m", None, 600.0, at, 300.0) is None
+    assert raw_only.rollup_steps == ()
+    assert raw_only.downsample_policy is None
+
+
+def test_late_born_series_does_not_force_raw_fallback():
+    db = _db()
+    for i in range(400):
+        db.append("m", lbl(pod="p0"), float(i), ts=5.0 * (i + 1))
+    # born after the evaluation instant: invisible to the window either way,
+    # so it must not poison the tier read for everyone else
+    db.append("m", lbl(pod="late"), 42.0, ts=1900.0)
+    vec = db.rollup_range_avg("m", None, 600.0, 1800.0, 300.0)
+    assert vec is not None
+    assert [s.labels for s in vec] == [lbl(pod="p0")]
+    assert _pairs(vec) == _pairs(
+        db.range_avg_bucketed("m", None, 600.0, 1800.0, step=300.0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# planner tier selection
+
+
+def test_planner_selects_the_coarsest_aligned_tier_and_stays_bit_exact():
+    db = _db()
+    pods = [lbl(pod=f"p{i}") for i in range(3)]
+    for i in range(400):
+        for j, labels in enumerate(pods):
+            db.append("m", labels, float(j * 50 + i % 11), ts=5.0 * (i + 1))
+    db.clock.advance(1800.0 - db.clock.now())
+    planner = QueryPlanner(db)
+
+    plan = planner.plan(AvgOverTime("m", 600.0, {}))
+    naive = AvgOverTime("m", 600.0, {})
+    assert _vectors_identical(plan.evaluate(db), naive.evaluate(db))
+    assert planner.stats.rollup_reads == {"5m": 1}  # coarsest aligned tier wins
+
+    plan_fine = planner.plan(AvgOverTime("m", 60.0, {}))
+    assert _vectors_identical(
+        plan_fine.evaluate(db), AvgOverTime("m", 60.0, {}).evaluate(db)
+    )
+    assert planner.stats.rollup_reads == {"5m": 1, "1m": 1}
+
+    # an unaligned instant is not tier-ELIGIBLE: raw serves it and neither
+    # the per-tier read counters nor the fallback counter move
+    db.clock.advance(7.0)
+    before = dict(planner.stats.rollup_reads)
+    assert _vectors_identical(plan.evaluate(db), naive.evaluate(db))
+    assert planner.stats.rollup_reads == before
+    assert planner.stats.rollup_fallbacks == 0
+
+    # a matching series NOT compacted through `at` forces the whole query
+    # back to raw — counted, and still bit-identical to the naive walk
+    db.append("m", lbl(pod="hole"), 1.0, ts=1700.0)
+    assert _vectors_identical(
+        plan.evaluate(db, at=1800.0), naive.evaluate(db, at=1800.0)
+    )
+    assert planner.stats.rollup_fallbacks == 1
+
+
+# ---------------------------------------------------------------------------
+# compaction triggers & retention
+
+
+def test_compact_on_evict_preserves_history_beyond_raw_retention():
+    # horizon (1h) is never reached inside the 50-minute run: every rollup
+    # bucket below exists only because eviction compacted chunks on the way
+    # out of the 240s raw window
+    policy = DownsamplePolicy(steps=(60.0,), horizon=3600.0)
+    db = TimeSeriesDB(
+        VirtualClock(),
+        lookback=60.0,
+        retention=240.0,
+        chunk_size=4,
+        downsample=policy,
+    )
+    labels = lbl(pod="p0")
+    for i in range(600):
+        db.append("m", labels, float(i % 9), ts=5.0 * (i + 1))
+    ends = sorted(
+        row[0] for _, rows in db.rollup_rows("m", step=60.0) for row in rows
+    )
+    assert ends and ends[0] == 60.0  # history from minute one survives
+    assert ends[-1] >= 2400.0
+    assert db.rollup_storage_stats()["ingested_chunks"] > 0
+    # ...while raw genuinely forgot it
+    assert db._data["m"][labels].chunks[0].first_ts > ends[0]
+
+
+def test_rollup_retention_trims_the_front():
+    policy = DownsamplePolicy(steps=(60.0,), horizon=120.0, retention=600.0)
+    db = _db(policy=policy)
+    labels = lbl(pod="p0")
+    for i in range(600):
+        db.append("m", labels, float(i % 9), ts=5.0 * (i + 1))
+    ends = sorted(
+        row[0] for _, rows in db.rollup_rows("m", step=60.0) for row in rows
+    )
+    assert ends
+    # whole rollup chunks (chunk_size=4 buckets) drop once wholly past
+    # now - retention, so the oldest survivor sits within one chunk of it
+    assert ends[0] >= 3000.0 - 600.0 - 4 * 60.0
+    assert db.rollup_storage_stats()["dropped_buckets"] > 0
+
+
+# ---------------------------------------------------------------------------
+# accounting & federation
+
+
+def test_storage_stats_account_the_rollup_plane():
+    raw_only = TimeSeriesDB(VirtualClock())
+    assert raw_only.rollup_storage_stats() == {"enabled": False, "tiers": {}}
+    db = _db()
+    labels = lbl(pod="p0")
+    for i in range(400):
+        db.append("m", labels, float(i), ts=5.0 * (i + 1))
+    stats = db.rollup_storage_stats()
+    assert stats["enabled"] is True
+    m1, m5 = stats["tiers"]["1m"], stats["tiers"]["5m"]
+    assert m1["series"] == m5["series"] == 1
+    assert m1["buckets"] > m5["buckets"] > 0
+    assert m1["chunks"] >= 2  # chunk_size=4: sealed rollup CHUNKS, not one blob
+    assert stats["rollup_bytes"] == m1["bytes"] + m5["bytes"] > 0
+    assert stats["sealed_buckets"] >= m1["buckets"] + m5["buckets"]
+    assert stats["ingested_points"] > 0
+
+
+def test_federated_rollup_reads_merge_and_stay_bit_exact():
+    clock = VirtualClock()
+    global_db = TimeSeriesDB(clock, retention=10**9)  # raw-only, no "m" series
+    shards = [
+        TimeSeriesDB(clock, retention=10**9, chunk_size=4, downsample=POLICY)
+        for _ in range(2)
+    ]
+    fed = FederatedTSDB(global_db, shards)
+    for i in range(400):
+        ts = 5.0 * (i + 1)
+        for s, db in enumerate(shards):
+            db.append("m", lbl(pod=f"shard{s}"), float(s * 10 + i % 5), ts=ts)
+    assert fed.rollup_steps == (60.0, 300.0)
+    assert fed.downsample_policy == POLICY
+    vec = fed.rollup_range_avg("m", None, 600.0, 1800.0, 300.0)
+    assert vec is not None and len(vec) == 2  # one sample per shard, merged
+    assert _pairs(vec) == _pairs(
+        fed.range_avg_bucketed("m", None, 600.0, 1800.0, step=300.0)
+    )
+    merged = fed.rollup_storage_stats()
+    per_shard = [db.rollup_storage_stats() for db in shards]
+    assert merged["enabled"] is True
+    assert merged["tiers"]["5m"]["buckets"] == sum(
+        s["tiers"]["5m"]["buckets"] for s in per_shard
+    )

@@ -213,10 +213,14 @@ def test_capture_brackets_fan_out_to_every_member():
     fed.begin_capture()
     fed.instant_vector("m")
     captured = fed.end_capture()
-    assert {(name, labels, origin) for name, labels, _v, _ts, origin in captured} == {
+    assert {
+        (name, labels, origin)
+        for name, labels, _v, _ts, origin, _tier in captured
+    } == {
         ("m", lbl(a="x"), 7),
         ("m", lbl(a="y"), 8),
     }
+    assert {tier for *_rest, tier in captured} == {"raw"}
 
 
 # ---- the federation rule pattern -------------------------------------------

@@ -101,6 +101,114 @@ def test_parser_rejects_inputs_outside_the_subset():
 
 
 # ---------------------------------------------------------------------------
+# query mode (ISSUE 8 satellite): the dashboard's PromQL subset
+
+
+def test_query_mode_is_a_superset_of_the_rule_subset():
+    from k8s_gpu_hpa_tpu.metrics.promql import parse_query
+
+    for _, expr in SHIPPED:
+        text = expr.promql()
+        assert parse_query(text) == parse(text) == expr
+
+
+def test_query_mode_parses_dashboard_constructs():
+    from k8s_gpu_hpa_tpu.metrics.promql import (
+        Increase,
+        OrVector,
+        QHistogramQuantile,
+        QSelect,
+        Rate,
+        parse_query,
+    )
+
+    assert parse_query("rate(m[5m])") == Rate(Select("m", {}), 300.0)
+    assert parse_query("increase(m[5m])") == Increase(Select("m", {}), 300.0)
+    assert parse_query('max by(pod)(m{pod!=""})') == MaxBy(
+        ("pod",), QSelect("m", (("pod", "!=", ""),))
+    )
+    assert parse_query(
+        'count(ALERTS{alertname=~"Tpu.+",alertstate="firing"}) or vector(0)'
+    ) == OrVector(
+        Aggregate(
+            "count",
+            QSelect(
+                "ALERTS",
+                (("alertname", "=~", "Tpu.+"), ("alertstate", "=", "firing")),
+            ),
+        ),
+        0.0,
+    )
+    assert parse_query(
+        "histogram_quantile(0.95, sum by(le)(rate(h_bucket[5m])))"
+    ) == QHistogramQuantile(
+        0.95,
+        AggregateBy("sum", ("le",), Rate(Select("h_bucket", {}), 300.0)),
+    )
+    # a bare _bucket selector canonicalizes to the RULE-subset node, so a
+    # panel and an alert over the same read share one AST
+    assert parse_query("histogram_quantile(0.99, h_bucket)") == parse(
+        "histogram_quantile(0.99, h_bucket)"
+    )
+
+
+def test_query_mode_renders_canonically():
+    from k8s_gpu_hpa_tpu.metrics.promql import parse_query
+
+    for text in (
+        "rate(m[5m])",
+        'sum by(reason)(increase(decisions_total{job="hpa"}[1h]))',
+        'max by(pod)(m{pod!=""})',
+        'count(ALERTS{alertname=~"Tpu.+",alertstate="firing"}) or vector(0)',
+        "sum(held) or vector(0)",
+        "histogram_quantile(0.5, sum by(le)(rate(h_bucket[5m])))",
+        "increase(m{state!~\"idle\"}[5m])",
+    ):
+        assert parse_query(text).promql() == text
+
+
+def test_rule_mode_still_rejects_query_only_constructs():
+    for bad in (
+        "rate(m[5m])",
+        "sum(m) or vector(0)",
+        'm{pod!=""}',
+        'm{job=~"x"}',
+        "increase(m[5m])",  # bare increase only means something in query mode
+    ):
+        with pytest.raises(PromQLError):
+            parse(bad)
+
+
+def test_query_mode_still_rejects_out_of_subset_input():
+    from k8s_gpu_hpa_tpu.metrics.promql import parse_query
+
+    for bad in (
+        "m + n",
+        "rate(m[5m]) or vector",  # vector() needs a scalar literal
+        'avg_over_time(m{pod!=""}[5m])',  # the closed loop evaluates this
+        "or vector(0)",
+        "rate(sum(m)[5m])",  # rate over a non-selector
+    ):
+        with pytest.raises(PromQLError):
+            parse_query(bad)
+
+
+def test_dashboard_lint_passes_on_shipped_dashboard():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        from lint_promql_parity import lint_dashboard, lint_parity
+    finally:
+        sys.path.pop(0)
+    assert lint_parity() == []
+    errors, count = lint_dashboard()
+    assert errors == []
+    assert count >= 50  # every panel target linted, not an empty walk
+
+
+# ---------------------------------------------------------------------------
 # differential: planned vs naive on randomized layouts
 
 

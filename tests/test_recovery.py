@@ -533,3 +533,200 @@ def test_kill_at_any_byte_with_chunk_seals_recovers(tmp_path):
         assert _state(recovered, at=59.0) == _state(reference, at=59.0), (
             f"cut at byte {cut}: recovered state diverged (chunk_size=4)"
         )
+
+
+# ---- rollup tiers across the restart boundary (ISSUE 8) ---------------------
+
+
+def _fast_policy():
+    """Tiers sized so a few hundred 5s appends compact: 1m/5m buckets,
+    chunks aged 2 minutes past the newest append get ingested."""
+    from k8s_gpu_hpa_tpu.metrics.downsample import DownsamplePolicy
+
+    return DownsamplePolicy(steps=(60.0, 300.0), horizon=120.0)
+
+
+def _populate_past_horizon(db: TimeSeriesDB, ticks: int = 240) -> None:
+    """5s-cadence appends spanning 20 minutes — far past the 2-minute
+    horizon, so both tiers hold sealed buckets (and with chunk_size=4,
+    sealed rollup CHUNKS too)."""
+    for i in range(ticks):
+        ts = 5.0 * (i + 1)
+        for series_i, (name, labels) in enumerate(SERIES):
+            db.append(
+                name,
+                labels,
+                float(series_i * 100 + (i % 17)),
+                ts=ts,
+                origin=i if i % 3 == 0 else None,
+            )
+
+
+def _rollup_state(db: TimeSeriesDB) -> dict:
+    """Every stored rollup row plus per-tier coverage, for equality checks."""
+    from k8s_gpu_hpa_tpu.metrics.downsample import tier_label
+
+    ds = db._downsampler
+    if ds is None:
+        return {}
+    out: dict = {}
+    for step in ds.steps:
+        for name in sorted(db._data):
+            for labels, rows in db.rollup_rows(name, step=step):
+                out[(name, labels, tier_label(step))] = tuple(rows)
+    for name in sorted(db._data):
+        for labels, series in db._data[name].items():
+            if series.rollup is None:
+                continue
+            for step, tier in zip(ds.steps, series.rollup.tiers):
+                out[("covered", name, labels, step)] = tier.covered_through
+    return out
+
+
+def test_v3_snapshot_round_trips_rollup_state_bit_exact(tmp_path):
+    """Format-3 snapshots carry the rollup plane verbatim: sealed rollup
+    chunk columns restore byte-identical, the compressed tier heads and the
+    open-bucket accumulators resume, and ``downsample=None`` adopts the
+    recorded policy — a restart keeps compacting without being re-told how."""
+    clock = VirtualClock()
+    wal = WriteAheadLog(tmp_path / "wal")
+    db = TimeSeriesDB(
+        clock, wal=wal, chunk_size=4, downsample=_fast_policy()
+    )
+    _populate_past_horizon(db)
+    assert db.rollup_storage_stats()["sealed_buckets"] > 0
+    db.snapshot()
+    wal.close()
+
+    recovered = TimeSeriesDB.recover(
+        WriteAheadLog(tmp_path / "wal"), VirtualClock(), chunk_size=4
+    )
+    assert recovered.downsample_policy == db.downsample_policy
+    info = recovered.last_recovery
+    assert info["rollup_series_restored"] == len(SERIES)
+    assert info["rollup_series_rebuilt"] == 0
+    # sealed rollup chunk columns are bit-identical (restored, not re-built)
+    for name, labels in SERIES:
+        src = db._data[name][labels].rollup
+        dst = recovered._data[name][labels].rollup
+        for s_tier, d_tier in zip(src.tiers, dst.tiers):
+            assert [
+                (c.count, c.ts_blob, c.val_blobs, c.ts_mode) for c in d_tier.chunks
+            ] == [
+                (c.count, c.ts_blob, c.val_blobs, c.ts_mode) for c in s_tier.chunks
+            ]
+            assert d_tier.covered_through == s_tier.covered_through
+            assert d_tier.open_end == s_tier.open_end
+            assert d_tier.o_count == s_tier.o_count
+    assert _rollup_state(recovered) == _rollup_state(db)
+    # tier reads answer identically across the boundary
+    for step in (60.0, 300.0):
+        got = recovered.rollup_range_avg(
+            SERIES[0][0], None, window_s=4 * step, at=900.0, step=step
+        )
+        want = db.rollup_range_avg(
+            SERIES[0][0], None, window_s=4 * step, at=900.0, step=step
+        )
+        assert got is not None and _vec(got) == _vec(want)
+    # and the compactor keeps running: appends continue sealing buckets
+    before = recovered.rollup_storage_stats()["sealed_buckets"]
+    for i in range(240, 400):
+        recovered.append(SERIES[0][0], SERIES[0][1], float(i), ts=5.0 * (i + 1))
+    assert recovered.rollup_storage_stats()["sealed_buckets"] > before
+
+
+def _vec(samples):
+    return sorted((s.labels, s.value) for s in samples)
+
+
+def test_v2_snapshot_rebuilds_rollups_from_raw_chunks(tmp_path):
+    """A pre-rollup (format-2) snapshot recovered into a downsampling DB
+    rebuilds the tiers by re-ingesting the installed raw chunks — and the
+    rebuilt rollups agree with the raw bucketed twin float-for-float, so
+    upgrading a WAL directory to the downsampling engine loses nothing."""
+    clock = VirtualClock()
+    wal = WriteAheadLog(tmp_path / "wal")
+    db = TimeSeriesDB(clock, wal=wal, chunk_size=4)  # raw-only writer
+    _populate_past_horizon(db)
+    db.snapshot()
+    wal.close()
+
+    # rewrite the snapshot as a genuine v2 payload: no rollup/downsample keys
+    # exist in a raw-only snapshot, so only the format stamp changes
+    snap_path = tmp_path / "wal" / "snapshot.json"
+    payload = json.loads(snap_path.read_text())
+    assert "downsample" not in payload
+    payload["format"] = 2
+    snap_path.write_text(json.dumps(payload))
+
+    recovered = TimeSeriesDB.recover(
+        WriteAheadLog(tmp_path / "wal"),
+        VirtualClock(),
+        chunk_size=4,
+        downsample=_fast_policy(),
+    )
+    info = recovered.last_recovery
+    assert info["rollup_series_restored"] == 0
+    assert info["rollup_series_rebuilt"] == len(SERIES)
+    assert recovered.rollup_storage_stats()["sealed_buckets"] > 0
+    for step in (60.0, 300.0):
+        for name, _labels in SERIES:
+            got = recovered.rollup_range_avg(
+                name, None, window_s=4 * step, at=900.0, step=step
+            )
+            twin = recovered.range_avg_bucketed(
+                name, None, window_s=4 * step, at=900.0, step=step
+            )
+            assert got is not None and _vec(got) == _vec(twin)
+
+
+def test_kill_at_any_byte_with_rollups_recovers(tmp_path):
+    """The kill-at-any-byte property with the downsampler live: whatever
+    byte the crash lands on, WAL replay through ``append`` rebuilds not
+    just the raw store but the identical rollup rows and coverage marks a
+    reference DB gets from the same landed records — compaction is a pure
+    function of the append stream, so it needs no WAL records of its own."""
+    wal_dir = tmp_path / "wal"
+    wal = WriteAheadLog(wal_dir, segment_max_records=64)
+    db = TimeSeriesDB(
+        VirtualClock(), wal=wal, chunk_size=4, downsample=_fast_policy()
+    )
+    _populate_past_horizon(db)
+    assert db.rollup_storage_stats()["sealed_buckets"] > 0
+    wal.close()
+
+    segments = sorted(wal_dir.glob("wal-*.jsonl"))
+    final_bytes = segments[-1].read_bytes()
+    prefix_records: list[dict] = []
+    for seg in segments[:-1]:
+        for line in seg.read_text().splitlines():
+            prefix_records.append(json.loads(line))
+
+    for cut in list(range(0, len(final_bytes), 173)) + [len(final_bytes)]:
+        case_dir = tmp_path / f"rollup-cut-{cut}"
+        shutil.copytree(wal_dir, case_dir)
+        (case_dir / segments[-1].name).write_bytes(final_bytes[:cut])
+        recovered = TimeSeriesDB.recover(
+            WriteAheadLog(case_dir),
+            VirtualClock(),
+            chunk_size=4,
+            downsample=_fast_policy(),
+        )
+        landed = list(prefix_records)
+        for line in final_bytes[:cut].split(b"\n"):
+            if not line:
+                continue
+            try:
+                landed.append(json.loads(line))
+            except ValueError:
+                pass
+        reference = TimeSeriesDB(
+            VirtualClock(), chunk_size=4, downsample=_fast_policy()
+        )
+        _apply_records(reference, landed)
+        assert _state(recovered, at=1200.0) == _state(reference, at=1200.0), (
+            f"cut at byte {cut}: raw state diverged with rollups present"
+        )
+        assert _rollup_state(recovered) == _rollup_state(reference), (
+            f"cut at byte {cut}: rollup state diverged from the reference"
+        )

@@ -185,3 +185,40 @@ def test_saturated_ceiling_above_band_scales_and_reports_reachable():
     assert "target reachable" in report.target_note
     assert report.scale_up_latency is not None
     assert max(replicas for _, _, _, replicas, _ in report.timeline) == 4
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder (ISSUE 8): history + why served from the rollup tiers
+
+
+def test_history_flight_recorder_serves_hours_from_rollups():
+    from k8s_gpu_hpa_tpu.simulate import render_history, run_history
+
+    result = run_history(days=0.125)  # 3 virtual hours, seconds of wall time
+    assert result["ok"] is True and result["violations"] == []
+    tiers = result["tier_stats"]["tiers"]
+    assert tiers["5m"]["buckets"] > 0 and tiers["1h"]["buckets"] > 0
+    assert result["scale_events"]
+    assert all(e["complete"] for e in result["scale_events"])
+    # the mid-run TSDB crash + WAL replay happened, and the tiers survived it
+    assert any(r["component"] == "tsdb" for r in result["restarts"])
+    assert any(h["replicas_avg"] is not None for h in result["hours"].values())
+    text = render_history(result)
+    assert "hourly view from the rollup tiers" in text
+    assert "[restart tsdb]" in text
+    assert "HISTORY CONTRACT VIOLATED" not in text
+
+
+def test_why_replays_a_scale_events_lineage_and_rejects_unknown_ids():
+    from k8s_gpu_hpa_tpu.simulate import render_why, run_history, run_why
+
+    first = run_history(days=0.125)["scale_events"][0]["span_id"]
+    result = run_why(first, days=0.125)  # deterministic: same run, same ids
+    assert result["ok"] is True and result["complete"] is True
+    kinds = [h["kind"] for h in result["hops"]]
+    assert kinds[0] == "scale_event" and kinds[-1] == "exporter_sample"
+    assert "lineage: COMPLETE" in render_why(result)
+
+    missing = run_why(10**9, days=0.125)
+    assert missing["ok"] is False
+    assert "no scale event" in missing["error"]

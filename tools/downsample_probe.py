@@ -1,0 +1,81 @@
+"""Run the doctor's rollup-tier probe against a freshly compacted TSDB.
+
+The tier-1 teeth for ISSUE 8's long-horizon plane: build a small
+deterministic DB with the default DownsamplePolicy, age six virtual hours
+of 30 s-cadence fleet-shaped series through the 5m/1h compactor, then run
+``doctor.diagnose`` with a ``downsample_fetch`` wired to
+``downsample_selfcheck`` — the same probe an operator would point at a
+live pipeline.  The probe fails (exit 1) when any configured tier holds
+zero sealed buckets, when the rollup fold disagrees float-for-float with
+the raw bucketed twin on tier-aligned windows, or when no window could be
+differentially verified at all.  Exit 0 IS the statement "long-horizon
+reads are faithful to raw history".
+
+Usage:
+    python tools/downsample_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from k8s_gpu_hpa_tpu.doctor import diagnose  # noqa: E402
+from k8s_gpu_hpa_tpu.metrics.downsample import (  # noqa: E402
+    DownsamplePolicy,
+    downsample_selfcheck,
+)
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB  # noqa: E402
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock  # noqa: E402
+
+HOURS = 6.0
+SERIES = 8
+INTERVAL_S = 30.0
+
+
+def build_db() -> TimeSeriesDB:
+    clock = VirtualClock()
+    db = TimeSeriesDB(
+        clock,
+        retention=(HOURS + 1.0) * 3600.0,
+        downsample=DownsamplePolicy(),
+    )
+    labels = [
+        tuple(sorted({"job": "probe", "instance": f"p-{i:02d}"}.items()))
+        for i in range(SERIES)
+    ]
+    ts = 0.0
+    for tick in range(int(HOURS * 3600.0 / INTERVAL_S)):
+        ts += INTERVAL_S
+        clock.advance(INTERVAL_S)
+        for i, lab in enumerate(labels):
+            # quantized diurnal-ish gauge with an occasional staleness NaN,
+            # same texture the bench differential uses
+            value = 10.0 + i + round(math.sin(ts / 900.0) * 4.0) / 4.0
+            if tick % 97 == 13 and i == 0:
+                value = math.nan
+            db.append("probe_duty_cycle", lab, value)
+    return db
+
+
+def main(argv: list[str]) -> int:
+    if argv:
+        print(__doc__.split("Usage:")[1].strip(), file=sys.stderr)
+        return 2
+    db = build_db()
+    payload = json.dumps(downsample_selfcheck(db, ["probe_duty_cycle"]))
+    results = diagnose(downsample_fetch=lambda: payload)
+    by_name = {r.name: r for r in results}
+    probe = by_name["L3 rollup tiers"]
+    status = "ok" if probe.ok else "FAIL"
+    print(f"downsample_probe: [{status}] {probe.name}: {probe.detail}")
+    return 0 if probe.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -138,7 +138,7 @@ def _quantile_targets(hist: str) -> list[dict]:
     histogram_quantile read every latency panel uses."""
     return [
         _target(
-            f"histogram_quantile({q}, sum by(le) "
+            f"histogram_quantile({q}, sum by(le)"
             f"(rate({hist}_bucket[5m])))",
             f"p{round(q * 100):g}",
             refid,
@@ -151,7 +151,7 @@ def _heatmap_panel(pid: int, title: str, x: int, y: int, hist: str, desc: str) -
     """A latency heatmap straight off the histogram's bucket rates; Grafana's
     native heatmap type with format=heatmap un-accumulates the le buckets."""
     target = _target(
-        f"sum by(le) (rate({hist}_bucket[5m]))", "{{le}}", "A"
+        f"sum by(le)(rate({hist}_bucket[5m]))", "{{le}}", "A"
     )
     target["format"] = "heatmap"
     return {
@@ -224,7 +224,7 @@ def build_dashboard() -> dict:
             "Per-pod tensorcore utilization (hottest chip)",
             0,
             8,
-            [_target('max by(pod) (tpu_tensorcore_utilization{pod!=""})', "{{pod}}", "A")],
+            [_target('max by(pod)(tpu_tensorcore_utilization{pod!=""})', "{{pod}}", "A")],
             "Each pod collapsed to its hottest chip — the same max-by the "
             "recording rule applies.",
             unit="percent",
@@ -237,7 +237,7 @@ def build_dashboard() -> dict:
             8,
             [
                 _target(
-                    'max by(pod) (tpu_hbm_memory_usage_bytes{pod!=""})',
+                    'max by(pod)(tpu_hbm_memory_usage_bytes{pod!=""})',
                     "{{pod}}",
                     "A",
                 ),
@@ -310,7 +310,7 @@ def build_dashboard() -> dict:
             24,
             [
                 _target(
-                    "max by(node) (tpu_metrics_exporter_sample_age_seconds)",
+                    "max by(node)(tpu_metrics_exporter_sample_age_seconds)",
                     "{{node}}",
                     "A",
                 )
@@ -402,7 +402,7 @@ def build_dashboard() -> dict:
             32,
             [
                 _target(
-                    "sum by(direction) "
+                    "sum by(direction)"
                     "(increase(quantum_operator_repairs_total[5m]))",
                     "repairs {{direction}}",
                     "A",
@@ -426,7 +426,7 @@ def build_dashboard() -> dict:
             40,
             [
                 _target(
-                    "sum by(queue) (tpu_test_queue_depth)",
+                    "sum by(queue)(tpu_test_queue_depth)",
                     "queued {{queue}}",
                     "A",
                 ),
@@ -464,7 +464,7 @@ def build_dashboard() -> dict:
             48,
             [
                 _target(
-                    f"max by(target) ({SCRAPE_DURATION})",
+                    f"max by(target)({SCRAPE_DURATION})",
                     "{{target}}",
                     "A",
                 )
@@ -481,7 +481,7 @@ def build_dashboard() -> dict:
             56,
             [
                 _target(
-                    f"sum by(reason) (increase({HPA_DECISION_TOTAL}[5m]))",
+                    f"sum by(reason)(increase({HPA_DECISION_TOTAL}[5m]))",
                     "{{reason}}",
                     "A",
                 )
@@ -498,7 +498,7 @@ def build_dashboard() -> dict:
             56,
             [
                 _target(
-                    f"max by(rule) ({RULE_EVAL_STALENESS})",
+                    f"max by(rule)({RULE_EVAL_STALENESS})",
                     "{{rule}}",
                     "A",
                 )

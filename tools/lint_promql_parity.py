@@ -13,12 +13,22 @@ closes the loop with the parser (metrics/promql.py):
   from the registry (or vice versa) fails — a rule only Prometheus runs, or
   only the simulator runs, is exactly the drift this repo exists to prevent.
 
+The Grafana dashboard (deploy/grafana-dashboard.yaml) gets the same
+treatment through the parser's QUERY mode (``promql.parse_query``): every
+panel target's ``expr`` must parse — rate()/increase(), ``!=``/``=~``
+matchers, ``or vector(0)`` and the ``sum by(le)(rate(..))`` quantile shape
+are all modeled — and must already be the canonical rendering
+(``parse_query(s).promql() == s``).  A panel graphing a typo'd or
+out-of-subset query is a dashboard lying about the pipeline with nothing
+failing; this lint makes it fail.
+
 Usage:
     python tools/lint_promql_parity.py
 """
 
 from __future__ import annotations
 
+import json
 import sys
 from pathlib import Path
 
@@ -28,11 +38,16 @@ sys.path.insert(0, str(REPO))
 import yaml  # noqa: E402
 
 from k8s_gpu_hpa_tpu.manifests import shipped_rule_groups  # noqa: E402
-from k8s_gpu_hpa_tpu.metrics.promql import PromQLError, parse  # noqa: E402
+from k8s_gpu_hpa_tpu.metrics.promql import (  # noqa: E402
+    PromQLError,
+    parse,
+    parse_query,
+)
 from k8s_gpu_hpa_tpu.metrics.rules import shipped_alert_rules  # noqa: E402
 from k8s_gpu_hpa_tpu.obs.slo import shipped_slo_alerts  # noqa: E402
 
 MANIFEST = REPO / "deploy" / "tpu-test-prometheusrule.yaml"
+DASHBOARD = REPO / "deploy" / "grafana-dashboard.yaml"
 
 
 def _registry() -> dict[str, list]:
@@ -95,19 +110,50 @@ def lint_parity(manifest_path: Path | None = None) -> list[str]:
     return errors
 
 
+def lint_dashboard(dashboard_path: Path | None = None) -> tuple[list[str], int]:
+    """(violations, expression count) over every Grafana panel target."""
+    dashboard_path = dashboard_path or DASHBOARD
+    doc = yaml.safe_load(dashboard_path.read_text())
+    errors: list[str] = []
+    count = 0
+    for fname, blob in sorted(doc["data"].items()):
+        dash = json.loads(blob)
+        for panel in dash.get("panels", []):
+            for target in panel.get("targets", []):
+                expr = target["expr"]
+                where = (
+                    f"dashboard {fname} panel {panel['id']} "
+                    f"({panel['title']!r}) ref {target.get('refId', '?')}"
+                )
+                count += 1
+                try:
+                    ast = parse_query(expr)
+                except PromQLError as e:
+                    errors.append(f"{where}: expr does not parse: {e}")
+                    continue
+                if ast.promql() != expr:
+                    errors.append(
+                        f"{where}: expr is not the canonical rendering "
+                        f"({expr!r} -> {ast.promql()!r})"
+                    )
+    return errors, count
+
+
 def main(argv: list[str]) -> int:
     if argv:
         print(__doc__.split("Usage:")[1].strip(), file=sys.stderr)
         return 2
     errors = lint_parity()
-    for err in errors:
+    dash_errors, dash_count = lint_dashboard()
+    for err in errors + dash_errors:
         print(f"lint_promql_parity: {err}")
-    if errors:
+    if errors or dash_errors:
         return 1
     n = sum(len(v) for v in _registry().values())
     print(
         f"lint_promql_parity ok: {n} manifest expressions parse back to "
-        "the exact ASTs the closed loop evaluates"
+        "the exact ASTs the closed loop evaluates; "
+        f"{dash_count} dashboard expressions parse canonically"
     )
     return 0
 

@@ -30,6 +30,11 @@ python tools/lint_faults.py || exit 1
 # manifest must parse (metrics/promql.py) back to the exact AST the closed
 # loop evaluates, and no rule may exist on only one side
 python tools/lint_promql_parity.py || exit 1
+# rollup-tier probe: age a deterministic DB through the 5m/1h compactor and
+# require the doctor's check_downsampling to pass — every tier holding
+# sealed buckets, rollup folds bit-agreeing with the raw bucketed twin on
+# tier-aligned windows
+python tools/downsample_probe.py || exit 1
 # recovery-drill smoke (small sizing: one component): kill the TSDB mid-run,
 # replay its WAL, and require reconvergence with zero spurious scale events
 # and lineage-complete traces — exit 0 IS the durability contract
