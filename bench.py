@@ -1714,6 +1714,43 @@ def run_rung_recovery_drill() -> dict:
     }
 
 
+def run_rung_capacity_crunch() -> dict:
+    """Multi-tenant capacity-crunch rung (chaos/crunch.py): three tenants of
+    different PriorityClasses spike into a bounded slice pool while the
+    cluster-autoscaler's cloud API fails and a node drains mid-squeeze.  The
+    acceptance bar is the capacity contract (perfgates CRUNCH_*): per-priority
+    time-to-capacity p95, zero pool-conservation or slice-boundary
+    violations, no starvation past a declared budget, no tenant evicted past
+    its preemption budget, and full convergence — surplus nodes reaped —
+    after the crunch clears.  Virtual time: deterministic run-to-run."""
+    from k8s_gpu_hpa_tpu.chaos import run_capacity_crunch
+
+    result = run_capacity_crunch()
+    return {
+        "mode": "virtual",
+        "metric": "capacity crunch (s, pending -> admitted, per tenant p95)",
+        "ttc_p95_s": {
+            name: t["ttc_p95_s"] for name, t in result["tenants"].items()
+        },
+        "max_pending_stint_s": {
+            name: t["max_pending_stint_s"]
+            for name, t in result["tenants"].items()
+        },
+        "preemptions": {
+            name: t["preemptions_suffered"]
+            for name, t in result["tenants"].items()
+        },
+        "preemptions_total": result["preemptions_total"],
+        "provisions": result["autoscaler"]["provisions"],
+        "provision_failures": result["autoscaler"]["provision_failures"],
+        "pool_conserved": result["pool"]["conserved_all"],
+        "audit_ticks": result["pool"]["audit_ticks"],
+        "all_recovered": result["all_recovered"],
+        "violations": result["violations"],
+        "ok": result["ok"],
+    }
+
+
 def run_rung_query_bench() -> dict:
     """Query-engine rung (metrics/planner.py + scale_harness): the fleet
     aggregate rule basket evaluated naive (logical ``Expr.evaluate``) and
@@ -2286,6 +2323,7 @@ def main() -> None:
             ("query_bench", run_rung_query_bench),
             ("downsample_bench", run_rung_downsample_bench),
             ("recovery_drill", run_rung_recovery_drill),
+            ("capacity_crunch", run_rung_capacity_crunch),
         ):
             log(f"rung {name}:")
             try:

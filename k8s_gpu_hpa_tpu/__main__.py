@@ -203,6 +203,7 @@ def main(argv: list[str] | None = None) -> int:
             "outage",
             "crash",
             "chaos",
+            "crunch",
             "trace",
             "drill",
             "slo",
@@ -257,6 +258,13 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="print the query planner's physical plan for every rule and "
         "alert the pipeline evaluates (see ARCHITECTURE.md: query engine)",
+    )
+    sim.add_argument(
+        "--starvation-budget",
+        type=float,
+        default=None,
+        help="override every tenant's starvation budget (seconds) for "
+        "--scenario crunch; 0 proves the contract can fail",
     )
 
     genm = sub.add_parser(

@@ -2,6 +2,12 @@
 pipeline: FaultSpecs armed by a ChaosSchedule, recovery accounted per fault
 as a RecoveryReport (detection time, degraded duration, MTTR)."""
 
+from k8s_gpu_hpa_tpu.chaos.crunch import (
+    CRUNCH_FAULTS,
+    evaluate_crunch_contract,
+    render_crunch_report,
+    run_capacity_crunch,
+)
 from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, FaultSpec
 from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule, RecoveryReport
 from k8s_gpu_hpa_tpu.chaos.storm import (
@@ -18,4 +24,8 @@ __all__ = [
     "STORM_FAULTS",
     "render_chaos_report",
     "run_fault_storm",
+    "CRUNCH_FAULTS",
+    "evaluate_crunch_contract",
+    "render_crunch_report",
+    "run_capacity_crunch",
 ]

@@ -1,0 +1,397 @@
+"""The capacity crunch: three tenants spike into a pool that cannot hold them.
+
+This is the ``capacity_crunch`` rung behind ``python -m k8s_gpu_hpa_tpu.simulate
+crunch`` and bench.py's rung of the same name.  Where the storm (:mod:`.storm`)
+breaks the *observability* plane one layer at a time, the crunch breaks the
+*supply* side: simultaneous demand spikes across three tenants of different
+PriorityClasses, a cloud API that refuses to provision right when the
+autoscaler needs it, and a node drain in the middle of the squeeze.  The thing
+under test is the capacity economy (``control/capacity.py``): priority
+admission, DRF fair-share at saturation, eviction-with-grace preemption, and
+provisioning backoff — scored by the contract in
+:func:`evaluate_crunch_contract`, with thresholds from :mod:`..perfgates`.
+
+Crunch cast (pool: 2 x 8-chip nodes, 4-chip slice quantum, autoscaler may add
+2 more 8-chip nodes):
+
+=========  ========  ======  ======  =========  =====  ====================
+tenant     priority  weight  chips/  preempt    max    peak demand
+                             pod     budget     repl.
+=========  ========  ======  ======  =========  =====  ====================
+tpu-prod   100       2.0     4       0 (never)  4      16 chips (latency)
+tpu-batch  10        1.0     2       6          6      12 chips (training)
+tpu-best   10        0.5     1       10         8      3 chips (best-effort)
+=========  ========  ======  ======  =========  =====  ====================
+
+Peak demand 31 chips against 16 base + 16 autoscaled — and the middle of the
+crunch takes one base node away.  Fault timeline (schedule-relative seconds):
+
+=========  =============================  ====================================
+t (s)      fault                          what must happen
+=========  =============================  ====================================
+140–240    provision_fail                 autoscaler attempts time out and
+                                          back off; nobody hot-loops the API
+150–510    tenant_spike tpu-prod (+130)   prod preempts the low band within
+                                          its TTC gate; victims re-queue
+155–510    tenant_spike tpu-batch (+170)  batch over its share yields to best
+                                          (FairShareLimited), waits for nodes
+160–510    tenant_spike tpu-best (+90)    best-effort rides fair share, is
+                                          never starved past its budget
+300–420    node_drain crunch-node-1       displaced prod pods re-admit onto
+                                          the freshly provisioned node
+=========  =============================  ====================================
+
+After 510 s the spikes clear: HPAs scale down, autoscaled nodes empty out and
+are reaped, and the contract requires full convergence with the pool audit
+conserved at every 5 s tick of the whole run.
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_hpa_tpu import perfgates
+from k8s_gpu_hpa_tpu.chaos.faults import FaultSpec
+from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule
+from k8s_gpu_hpa_tpu.control.capacity import CapacityConfig, TenantSpec
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import HPABehavior
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.obs.latency import percentile
+
+#: (name, priority, weight, preemption_budget, chips_per_pod, max_replicas,
+#:  base_load, spike_add) — starvation budgets come from perfgates so the
+#: contract and the gates can never drift apart
+CRUNCH_TENANTS = [
+    ("tpu-prod", 100, 2.0, 0, 4, 4, 30.0, 130.0),
+    ("tpu-batch", 10, 1.0, 6, 2, 6, 35.0, 170.0),
+    ("tpu-best", 10, 0.5, 10, 1, 8, 30.0, 90.0),
+]
+
+CRUNCH_FAULTS = [
+    FaultSpec("provision_fail", at=140.0, duration=100.0),
+    FaultSpec("tenant_spike", at=150.0, duration=360.0, target="tpu-prod",
+              params={"add": 130.0}),
+    FaultSpec("tenant_spike", at=155.0, duration=355.0, target="tpu-batch",
+              params={"add": 170.0}),
+    FaultSpec("tenant_spike", at=160.0, duration=350.0, target="tpu-best",
+              params={"add": 90.0}),
+    FaultSpec("node_drain", at=300.0, duration=120.0, target="crunch-node-1"),
+]
+
+
+def _ttc_gate_s(priority: int) -> float:
+    """The time-to-capacity p95 ceiling for a tenant's priority band: the
+    top band is served by preemption, everyone else by provisioning."""
+    if priority >= 100:
+        return perfgates.CRUNCH_HIGH_TTC_P95_MAX_S
+    return perfgates.CRUNCH_LOW_TTC_P95_MAX_S
+
+
+def run_capacity_crunch(
+    starvation_budget: float | None = None,
+    total: float = perfgates.CRUNCH_TOTAL_S,
+) -> dict:
+    """Run the canned crunch; returns a JSON-able result dict with the
+    contract already evaluated (``result["ok"]`` / ``result["violations"]``).
+
+    ``starvation_budget`` overrides every tenant's declared budget — the
+    ``simulate crunch --starvation-budget`` knob whose whole purpose is to
+    prove the contract can fail (0 fails any run that ever queued a pod)."""
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock,
+        nodes=[
+            (f"crunch-node-{i}", perfgates.CRUNCH_NODE_CHIPS)
+            for i in range(perfgates.CRUNCH_BASE_NODES)
+        ],
+        pod_start_latency=5.0,
+    )
+    tenants = []
+    for name, priority, weight, budget, _, _, _, _ in CRUNCH_TENANTS:
+        declared = perfgates.CRUNCH_STARVATION_BUDGETS_S[name]
+        tenants.append(
+            TenantSpec(
+                name,
+                priority=priority,
+                weight=weight,
+                preemption_budget=budget,
+                starvation_budget_s=(
+                    declared if starvation_budget is None else starvation_budget
+                ),
+            )
+        )
+    config = CapacityConfig(
+        tenants=tenants,
+        slice_quantum=perfgates.CRUNCH_SLICE_QUANTUM,
+        grace_s=perfgates.CRUNCH_EVICTION_GRACE_S,
+        autoscaler_node_chips=perfgates.CRUNCH_NODE_CHIPS,
+        autoscaler_max_nodes=perfgates.CRUNCH_AUTOSCALER_MAX_NODES,
+        provision_delay_s=perfgates.CRUNCH_PROVISION_DELAY_S,
+        provision_timeout_s=perfgates.CRUNCH_PROVISION_TIMEOUT_S,
+        backoff_base_s=30.0,
+        backoff_cap_s=240.0,
+    )
+
+    # Each tenant's offered load is a closure over a fixed base; tenant_spike
+    # wraps load_fn for its window, so bases must not share mutable state.
+    deployments: dict[str, SimDeployment] = {}
+    for name, _, _, _, chips, _, base, _ in CRUNCH_TENANTS:
+        deployments[name] = SimDeployment(
+            cluster,
+            name,
+            name,
+            chips_per_pod=chips,
+            load_fn=lambda t, b=base: b,
+            load_mode="shared",
+        )
+
+    # The capacity config rides in on the PRIMARY pipeline; the other two
+    # tenants join the same shared plane via add_tenant_hpa, so all three
+    # controllers are arbitrated by one CapacityScheduler.
+    prod = deployments["tpu-prod"]
+    cluster.add_deployment(prod, replicas=1)
+    clock.advance(10.0)
+    behavior = HPABehavior()
+    # Scale-down stabilization pinned to 60 s (storm precedent) so the
+    # post-crunch convergence the contract checks fits the run.
+    behavior.scale_down.stabilization_window_seconds = 60.0
+    pipe = AutoscalingPipeline(
+        cluster,
+        prod,
+        record="tpu_prod_tensorcore_avg",
+        target_value=40.0,
+        max_replicas=CRUNCH_TENANTS[0][5],
+        behavior=behavior,
+        capacity=config,
+    )
+    for name, _, _, _, _, max_replicas, _, _ in CRUNCH_TENANTS[1:]:
+        cluster.add_deployment(deployments[name], replicas=1)
+        tenant_behavior = HPABehavior()
+        tenant_behavior.scale_down.stabilization_window_seconds = 60.0
+        pipe.add_tenant_hpa(
+            deployments[name],
+            target_value=40.0,
+            max_replicas=max_replicas,
+            behavior=tenant_behavior,
+        )
+    scheduler = pipe.capacity_scheduler
+    autoscaler = scheduler.autoscaler
+
+    # The 5 s monitor is the invariant witness: the pool must audit conserved
+    # at EVERY tick, crunch or not — and it runs the autoscaler's scale-down
+    # half, so convergence includes giving surplus nodes back.
+    audits: list[dict] = []
+    reaped: list[str] = []
+
+    def monitor() -> None:
+        audits.append(scheduler.pool.audit())
+        reaped.extend(autoscaler.reap_idle(idle_s=120.0))
+        clock.call_later(5.0, monitor)
+
+    clock.call_later(5.0, monitor)
+
+    pipe.start()
+    clock.advance(120.0)  # settle: every tenant at base load
+    settled = {name: cluster.deployments[name].replicas for name in deployments}
+
+    schedule = ChaosSchedule(pipe, CRUNCH_FAULTS)
+    schedule.arm()
+    clock.advance(total)
+
+    tenant_results: dict[str, dict] = {}
+    for name, priority, weight, budget, chips, max_replicas, _, _ in CRUNCH_TENANTS:
+        spec = scheduler.tenants[name]
+        waits = scheduler.admission_waits.get(name, [])
+        pods = cluster.deployment_pods(name)
+        ttc_p95 = percentile(list(waits), 95.0)
+        tenant_results[name] = {
+            "priority": priority,
+            "weight": weight,
+            "chips_per_pod": chips,
+            "preemption_budget": budget,
+            "starvation_budget_s": spec.starvation_budget_s,
+            "ttc_gate_s": _ttc_gate_s(priority),
+            "admissions": len(waits),
+            "ttc_p95_s": None if ttc_p95 is None else round(ttc_p95, 1),
+            "max_pending_stint_s": round(
+                max(
+                    scheduler.max_pending_stint.get(name, 0.0),
+                    scheduler.open_stint_seconds(name),
+                ),
+                1,
+            ),
+            "pending_seconds": round(scheduler.tenant_pending_seconds(name), 1),
+            "preemptions_suffered": scheduler.preemptions_suffered.get(name, 0),
+            "final_replicas": cluster.deployments[name].replicas,
+            "final_running": len(cluster.running_pods(name)),
+            "final_pending": sum(1 for p in pods if p.phase == "Pending"),
+            "final_terminating": sum(1 for p in pods if p.phase == "Terminating"),
+            "scale_events": len(
+                pipe.scale_history
+                if name == prod.name
+                else pipe.tenant_scale_history[name]
+            ),
+        }
+
+    final_audit = scheduler.pool.audit()
+    result = {
+        "scenario": "capacity_crunch",
+        "mode": "virtual",
+        "settled": settled,
+        "tenants": tenant_results,
+        "pool": {
+            "capacity_final": final_audit["capacity"],
+            "used_final": final_audit["used"],
+            "audit_ticks": len(audits),
+            "conserved_all": all(a["conserved"] for a in audits)
+            and final_audit["conserved"],
+            "audit_violations": [
+                v for a in audits + [final_audit] for v in a["violations"]
+            ],
+        },
+        "autoscaler": {
+            "provisions": autoscaler.provisions_total,
+            "provision_failures": autoscaler.provision_failures_total,
+            "nodes_final": len(autoscaler.provisioned),
+            "reaped": reaped,
+        },
+        "preemptions_total": scheduler.preemptions_total,
+        "faults": [r.as_dict() for r in schedule.reports],
+        "all_recovered": schedule.all_recovered(),
+        "events": scheduler.events,
+    }
+    result["violations"] = evaluate_crunch_contract(result)
+    result["ok"] = not result["violations"]
+    return result
+
+
+def evaluate_crunch_contract(result: dict) -> list[str]:
+    """Score a crunch result against the capacity contract.  Pure over the
+    result dict (tests feed it doctored results to prove each clause fires):
+
+    - **conservation / slice boundary**: every 5 s audit conserved, zero
+      boundary violations;
+    - **time-to-capacity**: per-tenant admission-wait p95 within the
+      priority band's perfgates ceiling;
+    - **starvation**: no tenant's worst Pending stint (open stints at end
+      included) exceeds its declared budget;
+    - **preemption budget**: no tenant evicted more times than it declared
+      it would tolerate;
+    - **convergence**: after the crunch clears — every tenant's pods all
+      Running at the desired count, every fault recovered, surplus
+      autoscaled nodes reaped;
+    - **non-vacuity**: the run must actually have exercised preemption,
+      provisioning, AND provisioning failure — a crunch that never
+      squeezed proves nothing.
+    """
+    violations: list[str] = []
+    pool = result["pool"]
+    if not pool["conserved_all"]:
+        violations.append(
+            "pool conservation broken: "
+            + (
+                "; ".join(pool["audit_violations"][:3])
+                or "used + free != capacity on some tick"
+            )
+        )
+    for name, t in result["tenants"].items():
+        if t["ttc_p95_s"] is not None and t["ttc_p95_s"] > t["ttc_gate_s"]:
+            violations.append(
+                f"{name}: time-to-capacity p95 {t['ttc_p95_s']:.1f}s "
+                f"exceeds the {t['ttc_gate_s']:.0f}s gate"
+            )
+        if t["max_pending_stint_s"] > t["starvation_budget_s"]:
+            violations.append(
+                f"{name}: starved {t['max_pending_stint_s']:.1f}s, over its "
+                f"{t['starvation_budget_s']:.0f}s budget"
+            )
+        if t["preemptions_suffered"] > t["preemption_budget"]:
+            violations.append(
+                f"{name}: evicted {t['preemptions_suffered']} times, over its "
+                f"budget of {t['preemption_budget']}"
+            )
+        if (
+            t["final_running"] != t["final_replicas"]
+            or t["final_pending"]
+            or t["final_terminating"]
+        ):
+            violations.append(
+                f"{name}: did not converge ({t['final_running']}/"
+                f"{t['final_replicas']} running, {t['final_pending']} pending, "
+                f"{t['final_terminating']} terminating)"
+            )
+    if not result["all_recovered"]:
+        violations.append("not every fault recovered")
+    auto = result["autoscaler"]
+    if auto["nodes_final"] != 0:
+        violations.append(
+            f"{auto['nodes_final']} surplus autoscaled node(s) never reaped"
+        )
+    if result["preemptions_total"] < 1:
+        violations.append("vacuous run: no preemption ever happened")
+    if auto["provisions"] < 1:
+        violations.append("vacuous run: the autoscaler never provisioned")
+    if auto["provision_failures"] < 1:
+        violations.append("vacuous run: provision_fail never bit")
+    return violations
+
+
+#: the pod-lifecycle transitions worth a timeline line (requeue noise and
+#: autoscaler events render in their own sections)
+_TIMELINE_EVENTS = (
+    "pending",
+    "admitted",
+    "preempted",
+    "evicted",
+    "readmitted",
+    "fair_share_limited",
+)
+
+
+def render_crunch_report(result: dict) -> str:
+    tenants = result["tenants"]
+    lines = [
+        f"capacity crunch: {len(tenants)} tenants over a "
+        f"{result['pool']['capacity_final']}-chip pool, "
+        f"{result['preemptions_total']} preemptions, "
+        f"{result['autoscaler']['provisions']} nodes provisioned "
+        f"({result['autoscaler']['provision_failures']} failed attempts)",
+        "",
+        f"{'tenant':<10} {'prio':>4} {'ttc p95':>8} {'worst wait':>11} "
+        f"{'evicted':>8} {'final':>6}",
+    ]
+    for name, t in tenants.items():
+        ttc = "-" if t["ttc_p95_s"] is None else f"{t['ttc_p95_s']:.0f}s"
+        lines.append(
+            f"{name:<10} {t['priority']:>4} {ttc:>8} "
+            f"{t['max_pending_stint_s']:>6.0f}/{t['starvation_budget_s']:<3.0f}s "
+            f"{t['preemptions_suffered']:>4}/{t['preemption_budget']:<2} "
+            f"{t['final_running']:>3}/{t['final_replicas']}"
+        )
+    lines += ["", "timeline (pod lifecycle + pool events):"]
+    for e in result["events"]:
+        if e["event"] in _TIMELINE_EVENTS:
+            who = f"{e['tenant']}/{e['pod']}"
+        elif e["event"].startswith(("provision", "node_")):
+            who = "autoscaler"
+        else:
+            continue
+        lines.append(
+            f"  t={e['t']:7.1f}  {who:<28} {e['event']:<19} {e['detail']}"
+        )
+    lines += [
+        "",
+        f"pool audits conserved:   {result['pool']['conserved_all']} "
+        f"({result['pool']['audit_ticks']} ticks)",
+        f"all faults recovered:    {result['all_recovered']}",
+        f"autoscaled nodes reaped: {len(result['autoscaler']['reaped'])}",
+    ]
+    if result["violations"]:
+        lines.append("")
+        lines.append("CONTRACT VIOLATIONS:")
+        lines += [f"  - {v}" for v in result["violations"]]
+    else:
+        lines.append("")
+        lines.append("contract: all clauses hold")
+    return "\n".join(lines)

@@ -25,6 +25,11 @@ kind                      layer it breaks
 ``adapter_restart``       L4: custom-metrics API pod replaced (stateless)
 ``wal_truncate``          durability: destroy the WAL tail (torn record
                           included), then crash+recover the TSDB
+``tenant_spike``          L1: one tenant's offered load jumps (the demand side
+                          of a capacity crunch — stacks per tenant)
+``provision_fail``        L0: the cluster-autoscaler's cloud API hangs —
+                          provisions started in the window time out and back
+                          off (control/capacity.ClusterAutoscaler)
 ========================  =====================================================
 
 Injectors return a ``clear()`` callable that undoes the fault; duration-0
@@ -289,6 +294,72 @@ def _inject_wal_truncate(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearF
     return lambda: None
 
 
+def _inject_tenant_spike(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """One tenant's offered load jumps by ``params["add"]`` (default 60.0)
+    for the window — the demand side of a capacity crunch.  Targets the
+    pipeline's primary deployment by default; name any tenant deployment to
+    spike it instead.  Overlap-safe the same way ``_wrap_fetch`` is: stacked
+    spikes each wrap the load function in force, a per-deployment depth
+    counter restores the PRISTINE function only when the last clears."""
+    cluster = pipe.cluster
+    name = spec.target or pipe.deployment.name
+    deployment = cluster.deployments.get(name)
+    if deployment is None:
+        raise ValueError(f"tenant_spike: no deployment named {name!r}")
+    add = float(spec.params.get("add", 60.0))
+    depth = getattr(deployment, "_spike_depth", 0)
+    if depth == 0:
+        deployment._pristine_load_fn = deployment.load_fn
+    deployment._spike_depth = depth + 1
+    inner = deployment.load_fn
+    deployment.load_fn = lambda t: inner(t) + add
+    if pipe.tracer is not None:
+        pipe.tracer.emit(
+            "workload_change", {"deployment": name, "load_add": add}
+        )
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        deployment._spike_depth -= 1
+        if deployment._spike_depth == 0:
+            deployment.load_fn = deployment._pristine_load_fn
+
+    return clear
+
+
+def _inject_provision_fail(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """The cluster-autoscaler's cloud API hangs: provision attempts STARTED
+    during the window fail after ``provision_timeout_s`` and drive the
+    autoscaler's exponential backoff.  An attempt in flight when the window
+    closes still fails (its request is already lost).  Overlapping windows
+    stack via a depth counter; the flag drops when the last clears."""
+    scheduler = getattr(pipe, "capacity_scheduler", None)
+    autoscaler = getattr(scheduler, "autoscaler", None)
+    if autoscaler is None:
+        raise ValueError(
+            "provision_fail: pipeline has no cluster autoscaler attached "
+            "(pass capacity=CapacityConfig(autoscaler_node_chips=...))"
+        )
+    autoscaler._fail_depth += 1
+    autoscaler.failing = True
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        autoscaler._fail_depth -= 1
+        if autoscaler._fail_depth == 0:
+            autoscaler.failing = False
+
+    return clear
+
+
 FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = {
     "exporter_outage": _inject_exporter_outage,
     "frozen_samples": _inject_frozen_samples,
@@ -303,4 +374,6 @@ FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = 
     "hpa_restart": _inject_hpa_restart,
     "adapter_restart": _inject_adapter_restart,
     "wal_truncate": _inject_wal_truncate,
+    "tenant_spike": _inject_tenant_spike,
+    "provision_fail": _inject_provision_fail,
 }

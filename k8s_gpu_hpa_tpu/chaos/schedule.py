@@ -189,25 +189,34 @@ class ChaosSchedule:
         # while a fault is live (a spike during a blackout); whether the
         # *final* count is right is the caller's assertion (storm/tests).
         pipe = self.pipeline
-        dep = pipe.deployment
-        running = len(pipe.cluster.running_pods(dep.name))
-        if running != dep.replicas:
-            return False
-        if any(
-            p.phase == "CrashLoopBackOff"
-            for p in pipe.cluster.pods.values()
-            if p.deployment == dep.name
-        ):
-            return False
+        # Every autoscaled tenant must be converged, not just the pipeline's
+        # primary deployment — on a multi-tenant pool (control/capacity.py) a
+        # fault that leaves a SECOND tenant's pods pending is not recovered,
+        # even when the primary looks fine (the latent single-tenant
+        # assumption this check used to carry).
+        controllers = [(pipe.deployment, pipe.hpa)] + [
+            (pipe.cluster.deployments[name], hpa)
+            for name, hpa in getattr(pipe, "tenant_hpas", {}).items()
+        ]
+        for dep, hpa in controllers:
+            running = len(pipe.cluster.running_pods(dep.name))
+            if running != dep.replicas:
+                return False
+            if any(
+                p.phase == "CrashLoopBackOff"
+                for p in pipe.cluster.pods.values()
+                if p.deployment == dep.name
+            ):
+                return False
+            active = hpa.status.condition("ScalingActive")
+            if active is not None and not active.status:
+                return False
         for node in pipe.cluster.nodes.values():
             if not (node.ready and node.schedulable):
                 return False
         for target in pipe.scraper.targets:
             if not target.healthy:
                 return False
-        active = pipe.hpa.status.condition("ScalingActive")
-        if active is not None and not active.status:
-            return False
         return True
 
     def _tick(self) -> None:

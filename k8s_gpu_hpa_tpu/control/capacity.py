@@ -1,0 +1,888 @@
+"""Capacity economy: a bounded TPU slice pool arbitrated between tenants.
+
+Every pipeline before this one autoscaled a single deployment against
+effectively unlimited chips, so the hardest production failure mode — demand
+exceeding supply — was unexercised.  This module is the arbitration layer in
+the spirit of Borg's priority/quota economy and the Kubernetes scheduler's
+preemption semantics:
+
+- **SlicePool** — the bounded inventory: every ready node's chips, audited in
+  topology quanta (``slice_quantum`` chips per slice, the same whole-slice
+  atomicity ``control/operator.py`` enforces at the replica level).  The slice
+  boundary is the node: a pod's chips must all come from one node, and a
+  provisioned node is always a whole number of quanta.  ``audit()`` proves
+  conservation (used + free == capacity) and boundary integrity at any tick.
+- **TenantSpec** — one deployment's standing in the economy: PriorityClass
+  value, DRF-style fair-share weight, a preemption budget (how many evictions
+  it will tolerate), and a starvation budget (the longest continuous Pending
+  stint it accepts).
+- **CapacityScheduler** — replaces the cluster's naive first-fit when
+  installed (``SimCluster.scheduler``).  Pending pods are admitted by
+  priority; at saturation a weighted max-min fair share arbitrates *within* a
+  priority band (a tenant over its share yields to same-or-higher-priority
+  tenants under theirs — ``FairShareLimited``); higher priorities preempt
+  strictly-lower ones by **eviction with grace**: victims turn ``Terminating``,
+  keep their chips for the grace period (checkpoint/drain time), then
+  re-queue as ``Pending`` — they are never silently deleted, so every
+  preemption is observable as a pending→admitted→preempted→re-admitted round
+  trip in the event timeline.
+- **ClusterAutoscaler** — simulated node provisioning in whole quanta with a
+  realistic provisioning delay, a timeout when the cloud side hangs (the
+  ``provision_fail`` chaos fault), and exponential backoff on consecutive
+  failures so a broken cloud API is not hammered.
+- **PoolMetricsExporter** — pool self-metrics (``tpu_pool_*``) served as one
+  more scrape target, so saturation is observable through the same
+  exposition → TSDB → Grafana path as every other signal.
+
+Nothing here advances the clock: like the rest of the control plane, the
+scheduler only reacts to callbacks (`SimCluster._try_start` requeues) and
+schedules future work via ``clock.call_later``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimNode, SimPod
+from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+
+# ---- pool self-metric names (dashboard / test_manifests contract) ----------
+
+POOL_CAPACITY_CHIPS = "tpu_pool_capacity_chips"
+POOL_USED_CHIPS = "tpu_pool_used_chips"
+POOL_PENDING_PODS = "tpu_pool_pending_pods"
+POOL_PENDING_SECONDS = "tpu_pool_tenant_pending_seconds"
+POOL_PREEMPTIONS = "tpu_pool_preemptions_total"
+POOL_FAIR_SHARE_LIMITED = "tpu_pool_fair_share_limited"
+POOL_PROVISIONED_NODES = "tpu_pool_provisioned_nodes"
+POOL_PROVISIONS = "tpu_pool_provisions_total"
+POOL_PROVISION_FAILURES = "tpu_pool_provision_failures_total"
+
+#: every family the pool exporter serves — the dashboard generator and the
+#: manifest contract test import this instead of retyping the names
+POOL_METRIC_NAMES = (
+    POOL_CAPACITY_CHIPS,
+    POOL_USED_CHIPS,
+    POOL_PENDING_PODS,
+    POOL_PENDING_SECONDS,
+    POOL_PREEMPTIONS,
+    POOL_FAIR_SHARE_LIMITED,
+    POOL_PROVISIONED_NODES,
+    POOL_PROVISIONS,
+    POOL_PROVISION_FAILURES,
+)
+
+#: scrape-target name of the pool exporter (`exporter/<node>`-style namespacing
+#: is for per-node endpoints; the pool is a singleton like the pipeline self)
+POOL_TARGET_NAME = "capacity-pool"
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's standing in the chip economy (keyed by deployment name).
+
+    ``priority`` is a PriorityClass value: admission order, and only a
+    strictly higher priority may preempt.  ``weight`` is the DRF-style
+    fair-share weight arbitrating same-priority tenants at saturation.
+    ``preemption_budget`` caps how many evictions this tenant's pods will
+    suffer over a run — a victim tenant at budget becomes ineligible, which is
+    the graceful-degradation floor the crunch contract checks.
+    ``starvation_budget_s`` is the longest continuous Pending stint the tenant
+    declares acceptable; the crunch contract fails if any stint exceeds it."""
+
+    name: str
+    priority: int = 0
+    weight: float = 1.0
+    preemption_budget: int = 8
+    starvation_budget_s: float = 600.0
+
+    def __post_init__(self) -> None:
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name}: weight must be > 0")
+        if self.preemption_budget < 0:
+            raise ValueError(f"tenant {self.name}: preemption_budget must be >= 0")
+
+
+class SlicePool:
+    """The bounded chip inventory over a ``SimCluster``'s ready nodes.
+
+    Counts are always recomputed from the cluster's allocation maps — the
+    pool holds no shadow state that could drift, so ``audit()`` is a real
+    invariant check, not a self-consistency tautology."""
+
+    def __init__(self, cluster: SimCluster, slice_quantum: int = 1):
+        if slice_quantum < 1:
+            raise ValueError("slice_quantum must be >= 1")
+        self.cluster = cluster
+        self.slice_quantum = slice_quantum
+
+    def _ready_nodes(self) -> list[SimNode]:
+        return [n for n in self.cluster.nodes.values() if n.ready]
+
+    def capacity(self) -> int:
+        return sum(n.num_chips for n in self._ready_nodes())
+
+    def used(self) -> int:
+        return sum(len(n.allocations) for n in self._ready_nodes())
+
+    def free(self) -> int:
+        return sum(len(n.free_chips()) for n in self._ready_nodes())
+
+    def audit(self) -> dict:
+        """Conservation + slice-boundary invariants, checkable at any tick.
+
+        Violations (each a human-readable string):
+        - conservation: used + free != capacity on any ready node;
+        - a chip allocated to a pod that no longer exists, or whose own
+          bookkeeping (``pod.node`` / ``pod.chip_ids``) disagrees;
+        - a chip-holding pod split across nodes or holding the wrong count
+          (the slice boundary is the node — a pod may never straddle it);
+        - a node whose chip count is not a whole number of slice quanta.
+        """
+        violations: list[str] = []
+        cluster = self.cluster
+        q = self.slice_quantum
+        for node in cluster.nodes.values():
+            if node.num_chips % q:
+                violations.append(
+                    f"node {node.name}: {node.num_chips} chips is not a "
+                    f"whole number of slice quanta ({q})"
+                )
+            used = len(node.allocations)
+            free = len(node.free_chips())
+            if used + free != node.num_chips:
+                violations.append(
+                    f"node {node.name}: used {used} + free {free} != "
+                    f"capacity {node.num_chips}"
+                )
+            for idx, pod_name in node.allocations.items():
+                pod = cluster.pods.get(pod_name)
+                if pod is None:
+                    violations.append(
+                        f"node {node.name} chip {idx}: allocated to missing "
+                        f"pod {pod_name}"
+                    )
+                elif pod.node != node.name or idx not in pod.chip_ids:
+                    violations.append(
+                        f"node {node.name} chip {idx}: pod {pod_name} does "
+                        f"not claim it (pod.node={pod.node})"
+                    )
+        for pod in cluster.pods.values():
+            if pod.node is None:
+                continue
+            node = cluster.nodes.get(pod.node)
+            if node is None:
+                violations.append(f"pod {pod.name}: bound to missing node {pod.node}")
+                continue
+            if len(pod.chip_ids) != pod.chips_requested:
+                violations.append(
+                    f"pod {pod.name}: holds {len(pod.chip_ids)} chips, "
+                    f"requested {pod.chips_requested}"
+                )
+            for idx in pod.chip_ids:
+                if node.allocations.get(idx) != pod.name:
+                    violations.append(
+                        f"pod {pod.name}: claims chip {idx} on {node.name} "
+                        f"but the node disagrees"
+                    )
+        capacity, used, free = self.capacity(), self.used(), self.free()
+        return {
+            "capacity": capacity,
+            "used": used,
+            "free": free,
+            "conserved": used + free == capacity and not violations,
+            "violations": violations,
+        }
+
+
+class ClusterAutoscaler:
+    """Simulated cluster-autoscaler: provisions whole-quantum node slices.
+
+    ``request()`` is cheap and self-limiting — the scheduler calls it on every
+    failed placement, and the autoscaler ignores the call while an attempt is
+    in flight, while backing off after failures, or at ``max_nodes``.  A
+    failed provision (the ``provision_fail`` chaos fault) models a hung cloud
+    API: the attempt errors only after ``provision_timeout_s``, and
+    consecutive failures back off exponentially (base doubling, capped), so
+    the retry pressure comes from the pods' requeue loop, not a hot loop
+    here."""
+
+    def __init__(
+        self,
+        cluster: SimCluster,
+        node_chips: int,
+        provision_delay_s: float = 90.0,
+        provision_timeout_s: float = 120.0,
+        max_nodes: int = 2,
+        backoff_base_s: float = 30.0,
+        backoff_cap_s: float = 480.0,
+    ):
+        self.cluster = cluster
+        self.node_chips = node_chips
+        self.provision_delay_s = provision_delay_s
+        self.provision_timeout_s = provision_timeout_s
+        self.max_nodes = max_nodes
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        #: chaos flag (``provision_fail``): attempts *started* while set fail
+        #: after the timeout — an attempt already in flight when the fault
+        #: clears still fails, like a request already lost to a dead API
+        self.failing = False
+        #: chaos overlap depth (faults._inject_provision_fail)
+        self._fail_depth = 0
+        self.in_flight = False
+        self.backoff_until = -float("inf")
+        self.consecutive_failures = 0
+        self.provisions_total = 0
+        self.provision_failures_total = 0
+        #: autoscaled nodes currently in the cluster, in provisioning order
+        self.provisioned: list[str] = []
+        self._counter = 0
+        #: set by build_capacity so provisioning lands in the event timeline
+        self.scheduler: CapacityScheduler | None = None
+        self._empty_since: dict[str, float] = {}
+
+    def _event(self, event: str, detail: str = "") -> None:
+        if self.scheduler is not None:
+            self.scheduler.record_event("", "", event, detail)
+
+    def request(self) -> None:
+        clock = self.cluster.clock
+        now = clock.now()
+        if (
+            self.in_flight
+            or now < self.backoff_until
+            or len(self.provisioned) >= self.max_nodes
+        ):
+            return
+        self.in_flight = True
+        will_fail = self.failing
+        self._event(
+            "provision_requested",
+            f"{self.node_chips}-chip node, "
+            + (
+                f"will time out after {self.provision_timeout_s:.0f}s"
+                if will_fail
+                else f"ready in {self.provision_delay_s:.0f}s"
+            ),
+        )
+        if will_fail:
+            clock.call_later(self.provision_timeout_s, self._provision_failed)
+        else:
+            clock.call_later(self.provision_delay_s, self._provision_done)
+
+    def _provision_failed(self) -> None:
+        self.in_flight = False
+        self.provision_failures_total += 1
+        self.consecutive_failures += 1
+        delay = min(
+            self.backoff_cap_s,
+            self.backoff_base_s * 2.0 ** (self.consecutive_failures - 1),
+        )
+        self.backoff_until = self.cluster.clock.now() + delay
+        self._event(
+            "provision_failed",
+            f"failure #{self.consecutive_failures}, backing off {delay:.0f}s",
+        )
+
+    def _provision_done(self) -> None:
+        self.in_flight = False
+        self.consecutive_failures = 0
+        name = f"tpu-auto-{self._counter}"
+        self._counter += 1
+        self.cluster.add_node(name, self.node_chips)
+        self.provisioned.append(name)
+        self.provisions_total += 1
+        self._event("provisioned", f"node {name} ({self.node_chips} chips)")
+
+    def reap_idle(self, idle_s: float = 120.0) -> list[str]:
+        """Remove autoscaled nodes that have sat empty for ``idle_s`` —
+        the scale-down half of the autoscaler.  Called by harness monitors
+        (the crunch scenario's tick); never removes a node holding chips."""
+        now = self.cluster.clock.now()
+        reaped: list[str] = []
+        for name in list(self.provisioned):
+            node = self.cluster.nodes.get(name)
+            if node is None:
+                self.provisioned.remove(name)
+                self._empty_since.pop(name, None)
+                continue
+            if node.allocations:
+                self._empty_since.pop(name, None)
+                continue
+            since = self._empty_since.setdefault(name, now)
+            if now - since >= idle_s:
+                self.cluster.remove_node(name)
+                self.provisioned.remove(name)
+                self._empty_since.pop(name, None)
+                reaped.append(name)
+                self._event("node_reaped", f"node {name} idle {idle_s:.0f}s")
+        return reaped
+
+
+class CapacityScheduler:
+    """Priority + fair-share admission with eviction-with-grace preemption.
+
+    Installed as ``cluster.scheduler``; ``SimCluster._try_start`` routes every
+    placement attempt through ``try_place``.  The decision ladder, per pod:
+
+    1. **Yield walk** — all Pending pods are ordered by (priority desc,
+       used-chips/weight asc, waiting-longest first); this pod may bind only
+       with the chips left after every *more deserving* pod that fits has had
+       its claim reserved.  A more deserving pod that fits nowhere reserves
+       nothing (backfill: the big pod's wait must not idle chips a small pod
+       can use — the big pod's remedy is preemption/provisioning below).
+    2. **Fair-share gate** — at saturation, a tenant already at-or-over its
+       weighted share yields to same-or-higher-priority tenants under theirs:
+       no preemption, no provisioning on its behalf (``FairShareLimited``).
+       Lower-priority demand never limits a higher-priority tenant — priority
+       dominates, fairness arbitrates within a band.
+    3. **Preemption** — if strictly-lower-priority victims exist on some node
+       (budget permitting), evict the cheapest set with grace: victims turn
+       ``Terminating`` (chips still held), release at grace expiry, and
+       re-queue as ``Pending``.  Chips already incoming from in-flight
+       evictions count as available, so requeues never over-evict.
+    4. **Provisioning** — ask the autoscaler for another whole-quantum node.
+
+    Every transition lands in ``events`` — the per-tenant timeline the
+    ``simulate crunch`` CLI renders and the contract checks score."""
+
+    def __init__(
+        self,
+        cluster: SimCluster,
+        pool: SlicePool,
+        tenants: list[TenantSpec] | None = None,
+        grace_s: float = 5.0,
+    ):
+        self.cluster = cluster
+        self.pool = pool
+        self.grace_s = grace_s
+        self.tenants: dict[str, TenantSpec] = {t.name: t for t in (tenants or [])}
+        self.autoscaler: ClusterAutoscaler | None = None
+        #: (t, tenant, pod, event, detail) timeline; events are transitions
+        #: (pending/admitted/preempted/evicted/readmitted/fair_share_limited/
+        #: provision_*), never per-requeue noise, so the list stays bounded
+        self.events: list[dict] = []
+        #: pod name -> clock time its current Pending stint began
+        self.pending_since: dict[str, float] = {}
+        #: tenant -> closed-stint pending seconds (open stints added at read)
+        self.pending_seconds_total: dict[str, float] = {}
+        #: tenant -> longest single Pending stint seen (closed stints)
+        self.max_pending_stint: dict[str, float] = {}
+        #: tenant -> admission waits (seconds Pending before binding), the
+        #: time-to-capacity samples the crunch p95 gates score
+        self.admission_waits: dict[str, list[float]] = {}
+        #: tenant -> evictions suffered (the preemption-budget meter)
+        self.preemptions_suffered: dict[str, int] = {}
+        self.preemptions_total = 0
+        #: tenant -> in-flight evictions running on its behalf (drives the
+        #: beneficiary's ``Preempting`` HPA condition)
+        self.evictions_for: dict[str, int] = {}
+        #: pods evicted at least once — their next admission is a re-admission
+        self._preempted_pods: set[str] = set()
+        #: tenant -> currently held back by the fair-share gate
+        self.fair_share_limited: dict[str, bool] = {}
+        cluster.scheduler = self
+
+    # ---- tenants -----------------------------------------------------------
+
+    def tenant(self, name: str) -> TenantSpec:
+        """The tenant spec for a deployment, auto-registering defaults — an
+        unconfigured deployment participates at priority 0, weight 1."""
+        spec = self.tenants.get(name)
+        if spec is None:
+            spec = TenantSpec(name=name)
+            self.tenants[name] = spec
+        return spec
+
+    def used_chips(self, tenant: str) -> int:
+        return sum(
+            len(p.chip_ids)
+            for p in self.cluster.deployment_pods(tenant)
+            if p.node is not None
+        )
+
+    def pending_pods(self, tenant: str) -> list[SimPod]:
+        return [
+            p
+            for p in self.cluster.deployment_pods(tenant)
+            if p.phase == "Pending"
+        ]
+
+    def fair_share_chips(self, tenant: str) -> float:
+        """Weighted share of current capacity among tenants with live pods."""
+        active = [
+            name
+            for name in self.cluster.deployments
+            if self.cluster.deployment_pods(name)
+        ]
+        if tenant not in active:
+            active.append(tenant)
+        total_weight = sum(self.tenant(name).weight for name in active)
+        if total_weight <= 0:
+            return 0.0
+        return self.pool.capacity() * self.tenant(tenant).weight / total_weight
+
+    # ---- event timeline ----------------------------------------------------
+
+    def record_event(self, tenant: str, pod: str, event: str, detail: str = "") -> None:
+        self.events.append(
+            {
+                "t": self.cluster.clock.now(),
+                "tenant": tenant,
+                "pod": pod,
+                "event": event,
+                "detail": detail,
+            }
+        )
+
+    # ---- placement ---------------------------------------------------------
+
+    def _schedulable_nodes(self) -> list[SimNode]:
+        return [
+            n for n in self.cluster.nodes.values() if n.ready and n.schedulable
+        ]
+
+    def _pending_order(self) -> list[SimPod]:
+        now = self.cluster.clock.now()
+        share: dict[str, float] = {}
+
+        def key(p: SimPod):
+            spec = self.tenant(p.deployment)
+            if p.deployment not in share:
+                share[p.deployment] = self.used_chips(p.deployment) / spec.weight
+            return (
+                -spec.priority,
+                share[p.deployment],
+                self.pending_since.get(p.name, now),
+                p.name,
+            )
+
+        pending = [
+            p for p in self.cluster.pods.values() if p.phase == "Pending"
+        ]
+        return sorted(pending, key=key)
+
+    def try_place(self, pod: SimPod) -> bool:
+        """One placement attempt (the ``_try_start`` hook).  True iff the pod
+        bound to a node; False leaves it Pending on the cluster's requeue."""
+        nodes = self._schedulable_nodes()
+        budget = {n.name: len(n.free_chips()) for n in nodes}
+        for other in self._pending_order():
+            if other.name == pod.name:
+                for node in nodes:
+                    if budget[node.name] >= pod.chips_requested and (
+                        self.cluster.bind_pod(pod, node)
+                    ):
+                        self._record_admission(pod)
+                        return True
+                break
+            for name in budget:
+                if budget[name] >= other.chips_requested:
+                    budget[name] -= other.chips_requested
+                    break
+        self._note_pending(pod)
+        if self._fair_share_gate(pod):
+            return False
+        self._maybe_preempt(pod)
+        if self.autoscaler is not None:
+            self.autoscaler.request()
+        return False
+
+    def _note_pending(self, pod: SimPod) -> None:
+        if pod.name in self.pending_since:
+            return
+        self.pending_since[pod.name] = self.cluster.clock.now()
+        self.record_event(
+            pod.deployment,
+            pod.name,
+            "pending",
+            f"{pod.chips_requested} chips wanted, pool "
+            f"{self.pool.used()}/{self.pool.capacity()} used",
+        )
+
+    def _record_admission(self, pod: SimPod) -> None:
+        now = self.cluster.clock.now()
+        since = self.pending_since.pop(pod.name, None)
+        wait = 0.0 if since is None else now - since
+        tenant = pod.deployment
+        self.pending_seconds_total[tenant] = (
+            self.pending_seconds_total.get(tenant, 0.0) + wait
+        )
+        self.max_pending_stint[tenant] = max(
+            self.max_pending_stint.get(tenant, 0.0), wait
+        )
+        self.admission_waits.setdefault(tenant, []).append(wait)
+        if self.fair_share_limited.get(tenant):
+            self.fair_share_limited[tenant] = False
+        event = "readmitted" if pod.name in self._preempted_pods else "admitted"
+        self.record_event(
+            tenant, pod.name, event, f"node {pod.node}, waited {wait:.1f}s"
+        )
+
+    def _fair_share_gate(self, pod: SimPod) -> bool:
+        """True iff the pod's tenant must yield (over share while a same-or-
+        higher-priority tenant under its share has pending pods)."""
+        tenant = pod.deployment
+        spec = self.tenant(tenant)
+        over = (
+            self.used_chips(tenant) + pod.chips_requested
+            > self.fair_share_chips(tenant)
+        )
+        limited = False
+        if over:
+            for other in self.cluster.deployments:
+                if other == tenant:
+                    continue
+                other_spec = self.tenant(other)
+                if other_spec.priority < spec.priority:
+                    continue
+                if self.pending_pods(other) and (
+                    self.used_chips(other) < self.fair_share_chips(other)
+                ):
+                    limited = True
+                    break
+        if limited and not self.fair_share_limited.get(tenant):
+            self.record_event(
+                tenant,
+                pod.name,
+                "fair_share_limited",
+                f"using {self.used_chips(tenant)} of "
+                f"{self.fair_share_chips(tenant):.1f}-chip share",
+            )
+        self.fair_share_limited[tenant] = limited
+        return limited
+
+    def _incoming_chips(self, node: SimNode) -> int:
+        """Chips already freeing on the node: in-flight eviction victims."""
+        return sum(
+            len(p.chip_ids)
+            for p in self.cluster.pods.values()
+            if p.node == node.name and p.phase == "Terminating"
+        )
+
+    def _maybe_preempt(self, pod: SimPod) -> None:
+        spec = self.tenant(pod.deployment)
+        nodes = self._schedulable_nodes()
+        # an eviction wave already in flight that will make room anywhere
+        # means this requeue must wait, not evict more
+        for node in nodes:
+            if (
+                len(node.free_chips()) + self._incoming_chips(node)
+                >= pod.chips_requested
+            ):
+                return
+        for node in nodes:
+            victims = self._victims_on(node, spec, pod.chips_requested)
+            if victims is None:
+                continue
+            for victim in victims:
+                self._evict(victim, pod.deployment)
+            return
+
+    def _victims_on(
+        self, node: SimNode, spec: TenantSpec, need: int
+    ) -> list[SimPod] | None:
+        """The cheapest victim set on one node freeing enough chips for a
+        ``spec``-priority pod of the requesting tenant, or None.  Victims are
+        Running pods of strictly-lower-priority tenants with eviction budget
+        remaining, taken lowest-priority-first and newest-first (ReplicaSet
+        scale-down order) within a priority."""
+        have = len(node.free_chips()) + self._incoming_chips(node)
+        candidates = [
+            p
+            for p in self.cluster.pods.values()
+            if p.node == node.name
+            and p.phase == "Running"
+            and self.tenant(p.deployment).priority < spec.priority
+            and self.preemptions_suffered.get(p.deployment, 0)
+            < self.tenant(p.deployment).preemption_budget
+        ]
+        candidates.sort(
+            key=lambda p: (self.tenant(p.deployment).priority, -p.created_at)
+        )
+        chosen: list[SimPod] = []
+        budget_left = {
+            t: self.tenant(t).preemption_budget
+            - self.preemptions_suffered.get(t, 0)
+            for t in {p.deployment for p in candidates}
+        }
+        for p in candidates:
+            if have >= need:
+                break
+            if budget_left[p.deployment] <= 0:
+                continue
+            budget_left[p.deployment] -= 1
+            chosen.append(p)
+            have += len(p.chip_ids)
+        return chosen if chosen and have >= need else None
+
+    def _evict(self, victim: SimPod, beneficiary: str) -> None:
+        victim.phase = "Terminating"
+        tenant = victim.deployment
+        self.preemptions_suffered[tenant] = (
+            self.preemptions_suffered.get(tenant, 0) + 1
+        )
+        self.preemptions_total += 1
+        self.evictions_for[beneficiary] = self.evictions_for.get(beneficiary, 0) + 1
+        self._preempted_pods.add(victim.name)
+        self.record_event(
+            tenant,
+            victim.name,
+            "preempted",
+            f"victim of {beneficiary}, grace {self.grace_s:.0f}s",
+        )
+        self.cluster.clock.call_later(
+            self.grace_s, lambda: self._finish_eviction(victim, beneficiary)
+        )
+
+    def _finish_eviction(self, victim: SimPod, beneficiary: str) -> None:
+        self.evictions_for[beneficiary] = max(
+            0, self.evictions_for.get(beneficiary, 0) - 1
+        )
+        if (
+            self.cluster.pods.get(victim.name) is not victim
+            or victim.phase != "Terminating"
+        ):
+            return  # deleted (scale-down / node loss) during grace
+        if victim.node is not None:
+            node = self.cluster.nodes.get(victim.node)
+            if node is not None:
+                for idx in victim.chip_ids:
+                    node.allocations.pop(idx, None)
+        victim.node = None
+        victim.chip_ids = []
+        victim.phase = "Pending"
+        self.record_event(
+            victim.deployment, victim.name, "evicted", "grace elapsed, re-queued"
+        )
+        self._note_pending(victim)
+        self.cluster._try_start(victim)
+
+    # ---- lifecycle hooks ---------------------------------------------------
+
+    def on_pod_deleted(self, pod: SimPod) -> None:
+        """Cluster hook: close the pod's pending stint so per-tenant pending
+        accounting never leaks a deleted pod's open stint."""
+        since = self.pending_since.pop(pod.name, None)
+        if since is not None:
+            now = self.cluster.clock.now()
+            tenant = pod.deployment
+            stint = now - since
+            self.pending_seconds_total[tenant] = (
+                self.pending_seconds_total.get(tenant, 0.0) + stint
+            )
+            self.max_pending_stint[tenant] = max(
+                self.max_pending_stint.get(tenant, 0.0), stint
+            )
+        self._preempted_pods.discard(pod.name)
+
+    # ---- per-tenant status (the HPA capacity probe) ------------------------
+
+    def open_stint_seconds(self, tenant: str) -> float:
+        """Seconds the tenant's longest currently-open Pending stint has run."""
+        now = self.cluster.clock.now()
+        stints = [
+            now - since
+            for name, since in self.pending_since.items()
+            if (p := self.cluster.pods.get(name)) is not None
+            and p.deployment == tenant
+        ]
+        return max(stints, default=0.0)
+
+    def tenant_pending_seconds(self, tenant: str) -> float:
+        """Cumulative pending seconds, open stints included (monotonic — the
+        counter the pool exporter serves)."""
+        now = self.cluster.clock.now()
+        open_total = sum(
+            now - since
+            for name, since in self.pending_since.items()
+            if (p := self.cluster.pods.get(name)) is not None
+            and p.deployment == tenant
+        )
+        return self.pending_seconds_total.get(tenant, 0.0) + open_total
+
+    def tenant_status(self, tenant: str) -> dict:
+        """The capacity probe an ``HPAController`` surfaces as conditions."""
+        return {
+            "pending_pods": len(self.pending_pods(tenant)),
+            "evictions_in_flight": self.evictions_for.get(tenant, 0),
+            "fair_share_limited": bool(self.fair_share_limited.get(tenant)),
+            "preemptions_suffered": self.preemptions_suffered.get(tenant, 0),
+            "pending_seconds": self.tenant_pending_seconds(tenant),
+        }
+
+
+class PoolMetricsExporter:
+    """Pool self-metrics as one more scrape target (``capacity-pool``): the
+    same exposition → TSDB → Grafana path every other signal rides, so a
+    saturated pool is visible on the shipped dashboard, not just in test
+    asserts."""
+
+    def __init__(self, scheduler: CapacityScheduler):
+        self.scheduler = scheduler
+
+    def families(self) -> list[MetricFamily]:
+        sched = self.scheduler
+        pool = sched.pool
+        fams: list[MetricFamily] = []
+        cap = MetricFamily(POOL_CAPACITY_CHIPS, "gauge", "Chips on ready nodes")
+        cap.add(float(pool.capacity()))
+        used = MetricFamily(POOL_USED_CHIPS, "gauge", "Chips allocated to pods")
+        used.add(float(pool.used()))
+        fams += [cap, used]
+        tenants = sorted(
+            set(sched.tenants) | set(sched.cluster.deployments)
+        )
+        pending = MetricFamily(
+            POOL_PENDING_PODS, "gauge", "Pods awaiting capacity per tenant"
+        )
+        waiting = MetricFamily(
+            POOL_PENDING_SECONDS,
+            "counter",
+            "Cumulative seconds tenant pods have waited for capacity",
+        )
+        preempt = MetricFamily(
+            POOL_PREEMPTIONS, "counter", "Evictions suffered per tenant"
+        )
+        limited = MetricFamily(
+            POOL_FAIR_SHARE_LIMITED,
+            "gauge",
+            "1 while the tenant is held back by the fair-share gate",
+        )
+        for t in tenants:
+            pending.add(float(len(sched.pending_pods(t))), tenant=t)
+            waiting.add(sched.tenant_pending_seconds(t), tenant=t)
+            preempt.add(float(sched.preemptions_suffered.get(t, 0)), tenant=t)
+            limited.add(
+                1.0 if sched.fair_share_limited.get(t) else 0.0, tenant=t
+            )
+        fams += [pending, waiting, preempt, limited]
+        auto = sched.autoscaler
+        nodes = MetricFamily(
+            POOL_PROVISIONED_NODES, "gauge", "Autoscaled nodes in the cluster"
+        )
+        provs = MetricFamily(
+            POOL_PROVISIONS, "counter", "Successful node provisions"
+        )
+        fails = MetricFamily(
+            POOL_PROVISION_FAILURES, "counter", "Failed node provisions"
+        )
+        nodes.add(float(len(auto.provisioned)) if auto else 0.0)
+        provs.add(float(auto.provisions_total) if auto else 0.0)
+        fails.add(float(auto.provision_failures_total) if auto else 0.0)
+        fams += [nodes, provs, fails]
+        return fams
+
+    def exposition(self) -> str:
+        return encode_text(self.families())
+
+
+@dataclass
+class CapacityConfig:
+    """Everything ``AutoscalingPipeline(capacity=...)`` needs to stand up the
+    economy: the tenant roster, the slice quantum, eviction grace, and (when
+    ``autoscaler_node_chips`` is set) the simulated cluster-autoscaler."""
+
+    tenants: list[TenantSpec] = field(default_factory=list)
+    slice_quantum: int = 1
+    grace_s: float = 5.0
+    #: chips per autoscaled node (whole quanta); None = no autoscaler
+    autoscaler_node_chips: int | None = None
+    autoscaler_max_nodes: int = 2
+    provision_delay_s: float = 90.0
+    provision_timeout_s: float = 120.0
+    backoff_base_s: float = 30.0
+    backoff_cap_s: float = 480.0
+
+
+def build_capacity(cluster: SimCluster, config: CapacityConfig) -> CapacityScheduler:
+    """Stand up pool + scheduler (+ autoscaler) over a cluster and install
+    the scheduler as ``cluster.scheduler``."""
+    pool = SlicePool(cluster, slice_quantum=config.slice_quantum)
+    scheduler = CapacityScheduler(
+        cluster, pool, tenants=config.tenants, grace_s=config.grace_s
+    )
+    if config.autoscaler_node_chips is not None:
+        if config.autoscaler_node_chips % config.slice_quantum:
+            raise ValueError(
+                f"autoscaler_node_chips={config.autoscaler_node_chips} is not "
+                f"a whole number of slice quanta ({config.slice_quantum})"
+            )
+        autoscaler = ClusterAutoscaler(
+            cluster,
+            node_chips=config.autoscaler_node_chips,
+            provision_delay_s=config.provision_delay_s,
+            provision_timeout_s=config.provision_timeout_s,
+            max_nodes=config.autoscaler_max_nodes,
+            backoff_base_s=config.backoff_base_s,
+            backoff_cap_s=config.backoff_cap_s,
+        )
+        autoscaler.scheduler = scheduler
+        scheduler.autoscaler = autoscaler
+    return scheduler
+
+
+def capacity_selfcheck() -> dict:
+    """Canned mini-crunch for the doctor's ``check_capacity_pool`` probe: one
+    4-chip node, a low-priority tenant filling it, a high-priority tenant
+    arriving to force a preemption, and an autoscaler whose provisioned node
+    lets the victim return to Running — the full
+    pending→admitted→preempted→re-admitted round trip, with the pool audited
+    for conservation at every virtual second."""
+    from k8s_gpu_hpa_tpu.control.cluster import SimDeployment
+    from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+    clock = VirtualClock()
+    cluster = SimCluster(clock, nodes=[("tpu-node-0", 4)], pod_start_latency=2.0)
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[
+                TenantSpec("hi", priority=100, weight=1.0, preemption_budget=0),
+                TenantSpec("lo", priority=0, weight=1.0, preemption_budget=4),
+            ],
+            slice_quantum=4,
+            grace_s=2.0,
+            autoscaler_node_chips=4,
+            autoscaler_max_nodes=1,
+            provision_delay_s=20.0,
+        ),
+    )
+    lo = SimDeployment(cluster, "lo", "lo", chips_per_pod=4)
+    hi = SimDeployment(cluster, "hi", "hi", chips_per_pod=4)
+    audits: list[dict] = []
+
+    def tick():
+        audits.append(scheduler.pool.audit())
+        clock.call_later(1.0, tick)
+
+    clock.call_later(1.0, tick)
+    cluster.add_deployment(lo, replicas=1)
+    clock.advance(10.0)  # lo running on the only node
+    cluster.add_deployment(hi, replicas=1)  # forces preemption of lo
+    clock.advance(60.0)  # eviction + provisioning + lo re-admission
+    lo_pod_events = [
+        e["event"] for e in scheduler.events if e["tenant"] == "lo"
+    ]
+    roundtrip = (
+        "admitted" in lo_pod_events
+        and "preempted" in lo_pod_events
+        and "readmitted" in lo_pod_events
+    )
+    lo_running = len(cluster.running_pods("lo"))
+    hi_running = len(cluster.running_pods("hi"))
+    return {
+        "ticks": len(audits),
+        "conserved_all": all(a["conserved"] for a in audits),
+        "violations": [v for a in audits for v in a["violations"]],
+        "preemption_roundtrip": roundtrip,
+        "lo_running": lo_running,
+        "hi_running": hi_running,
+        "preemptions_total": scheduler.preemptions_total,
+        "events": [
+            {k: e[k] for k in ("t", "tenant", "pod", "event")}
+            for e in scheduler.events
+        ],
+    }

@@ -40,7 +40,11 @@ class SimPod:
     labels: dict[str, str]
     deployment: str
     chips_requested: int
-    phase: str = "Pending"  # Pending -> Running -> (deleted); CrashLoopBackOff
+    #: Pending -> Running -> (deleted); CrashLoopBackOff while the container
+    #: crashes on start; Terminating while a preemption eviction's grace
+    #: period runs (chips still held — control/capacity.py releases them and
+    #: re-queues the pod as Pending when the grace elapses)
+    phase: str = "Pending"
     node: str | None = None
     chip_ids: list[int] = field(default_factory=list)
     created_at: float = 0.0
@@ -247,9 +251,18 @@ class SimCluster:
         self.pods: dict[str, SimPod] = {}
         self.deployments: dict[str, SimDeployment] = {}
         self.pod_start_latency = pod_start_latency
+        self.exporter_sample_interval = exporter_sample_interval
         #: deployments whose containers currently crash on start (chaos):
         #: their pods cycle through CrashLoopBackOff instead of Running
         self.crashlooping: set[str] = set()
+        #: control/capacity.CapacityScheduler when the capacity economy is
+        #: installed: every placement routes through its priority/fair-share/
+        #: preemption ladder instead of the naive first-fit below
+        self.scheduler = None
+        #: callbacks fired when a node joins/leaves (the cluster-autoscaler
+        #: path) — control/loop.py keeps scrape targets in sync through these
+        self.on_node_added: list[Callable[[SimNode], None]] = []
+        self.on_node_removed: list[Callable[[str], None]] = []
         self._name_counter = itertools.count()
         self.exporters = {
             name: _NodeExporter(self, node, exporter_sample_interval)
@@ -289,7 +302,7 @@ class SimCluster:
         return pod
 
     def _try_start(self, pod: SimPod) -> None:
-        if pod.name not in self.pods or pod.phase == "Running":
+        if pod.name not in self.pods or pod.phase in ("Running", "Terminating"):
             return
         if pod.deployment in self.crashlooping:
             # Container starts, crashes immediately: CrashLoopBackOff with the
@@ -300,28 +313,49 @@ class SimCluster:
             delay = min(300.0, 10.0 * 2.0 ** (pod.restart_count - 1))
             self.clock.call_later(delay, lambda: self._try_start(pod))
             return
-        for node in self.nodes.values():
-            if not (node.ready and node.schedulable):
-                continue
-            free = node.free_chips()
-            if len(free) >= pod.chips_requested:
-                pod.node = node.name
-                pod.chip_ids = free[: pod.chips_requested]
-                for idx in pod.chip_ids:
-                    node.allocations[idx] = pod.name
-                pod.phase = "Running"
-                pod.started_at = self.clock.now()
-                return
+        if self.scheduler is not None:
+            placed = self.scheduler.try_place(pod)
+        else:
+            placed = self._first_fit(pod)
+        if placed:
+            return
         # No capacity: stay Pending, retry (kube-scheduler requeue).
         pod.phase = "Pending"
         self.clock.call_later(5.0, lambda: self._try_start(pod))
 
+    def _first_fit(self, pod: SimPod) -> bool:
+        """The naive scheduler (no capacity economy): first node that fits."""
+        for node in self.nodes.values():
+            if self.bind_pod(pod, node):
+                return True
+        return False
+
+    def bind_pod(self, pod: SimPod, node: SimNode) -> bool:
+        """Bind a pod to a node if it fits (the one place chips are assigned
+        — both the naive first-fit and the capacity scheduler end here, so
+        the pool audit has a single allocation path to trust)."""
+        if not (node.ready and node.schedulable):
+            return False
+        free = node.free_chips()
+        if len(free) < pod.chips_requested:
+            return False
+        pod.node = node.name
+        pod.chip_ids = free[: pod.chips_requested]
+        for idx in pod.chip_ids:
+            node.allocations[idx] = pod.name
+        pod.phase = "Running"
+        pod.started_at = self.clock.now()
+        return True
+
     def _delete_pod(self, pod: SimPod) -> None:
         if pod.node is not None:
-            node = self.nodes[pod.node]
-            for idx in pod.chip_ids:
-                node.allocations.pop(idx, None)
+            node = self.nodes.get(pod.node)
+            if node is not None:
+                for idx in pod.chip_ids:
+                    node.allocations.pop(idx, None)
         self.pods.pop(pod.name, None)
+        if self.scheduler is not None:
+            self.scheduler.on_pod_deleted(pod)
 
     def kill_pod(self, name: str) -> None:
         """Crash one pod (OOM, eviction, node blip).  The chips free
@@ -337,6 +371,36 @@ class SimCluster:
         self.reconcile(deployment)
 
     # ---- node lifecycle (spot/preemptible TPU slices) ----------------------
+
+    def add_node(self, name: str, num_chips: int) -> SimNode:
+        """A node slice joins the cluster (the cluster-autoscaler's provision
+        completing): schedulable immediately, with its own exporter endpoint.
+        ``on_node_added`` callbacks let the pipeline register the new scrape
+        target so the node is observable from its first sweep."""
+        if name in self.nodes:
+            raise ValueError(f"node {name} already exists")
+        node = SimNode(name, num_chips)
+        self.nodes[name] = node
+        self.exporters[name] = _NodeExporter(
+            self, node, self.exporter_sample_interval
+        )
+        for callback in list(self.on_node_added):
+            callback(node)
+        return node
+
+    def remove_node(self, name: str) -> None:
+        """A node slice leaves for good (autoscaler scale-down).  Refuses to
+        remove a node still holding chips — deprovisioning never kills pods;
+        that is what ``drain_node``/``preempt_node`` model."""
+        node = self.nodes.get(name)
+        if node is None:
+            raise KeyError(f"no node {name}")
+        if node.allocations:
+            raise ValueError(f"node {name} still has {len(node.allocations)} chips allocated")
+        self.nodes.pop(name)
+        self.exporters.pop(name, None)
+        for callback in list(self.on_node_removed):
+            callback(name)
 
     def preempt_node(self, name: str) -> None:
         """GKE spot/preemptible reclamation: the node vanishes NOW.  Resident

@@ -344,6 +344,7 @@ class HPAController:
         tracer=None,
         selfmetrics=None,
         checkpoint_store=None,
+        capacity_probe=None,
     ):
         self.target = target
         self.metrics = metrics
@@ -395,6 +396,12 @@ class HPAController:
         #: span id of the newest workload_change already credited with a
         #: propagation observation (one observation per change)
         self._propagation_seen: int | None = None
+        #: callable returning the tenant's capacity standing (the dict shape
+        #: of control/capacity.CapacityScheduler.tenant_status) — when set,
+        #: every sync surfaces Unschedulable / Preempting / FairShareLimited
+        #: conditions so a capacity-starved tenant is observable on its own
+        #: HPA object, exactly where an operator would look first
+        self.capacity_probe = capacity_probe
         #: control.checkpoint.CheckpointStore: sync-to-sync durable state.
         #: Restored here, at construction, so a restarted controller honors
         #: in-flight stabilization windows instead of flapping.
@@ -696,6 +703,47 @@ class HPAController:
         latency = max(0.0, event.start - change.start)
         self.selfmetrics.observe_propagation(latency, event.span_id)
 
+    def _capacity_conditions(self) -> None:
+        """Surface the tenant's standing in the capacity economy as k8s-style
+        conditions (control/capacity.py).  Runs every sync, metric outcome
+        notwithstanding — a pool-starved tenant usually still has metrics."""
+        if self.capacity_probe is None:
+            return
+        probe = self.capacity_probe()
+        pending = int(probe.get("pending_pods", 0))
+        self._set_condition(
+            "Unschedulable",
+            pending > 0,
+            "PodsPending" if pending > 0 else "AllPodsScheduled",
+            (
+                f"{pending} pod(s) awaiting pool capacity"
+                if pending > 0
+                else "every pod of the target is scheduled"
+            ),
+        )
+        evicting = int(probe.get("evictions_in_flight", 0))
+        self._set_condition(
+            "Preempting",
+            evicting > 0,
+            "EvictionInProgress" if evicting > 0 else "NoVictims",
+            (
+                f"{evicting} lower-priority victim(s) in eviction grace"
+                if evicting > 0
+                else "no evictions running on the target's behalf"
+            ),
+        )
+        limited = bool(probe.get("fair_share_limited", False))
+        self._set_condition(
+            "FairShareLimited",
+            limited,
+            "OverFairShare" if limited else "WithinFairShare",
+            (
+                "over weighted fair share while peers wait under theirs"
+                if limited
+                else "within the tenant's weighted fair share"
+            ),
+        )
+
     def _sync_inner(self) -> HPAStatus:
         current = self.target.replicas
         self.status.current_replicas = current
@@ -706,6 +754,7 @@ class HPAController:
             "SucceededGetScale",
             "the HPA controller was able to get the target's current scale",
         )
+        self._capacity_conditions()
 
         proposals = [self._metric_proposal(spec, current) for spec in self.metrics]
         valid = [p for p in proposals if p is not None]

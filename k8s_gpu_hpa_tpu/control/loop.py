@@ -69,11 +69,27 @@ class AutoscalingPipeline:
         checkpoint_store=None,
         scrape_shards: int = 0,
         downsample=None,
+        capacity=None,
     ):
         self.cluster = cluster
         self.deployment = deployment
         self.intervals = intervals or PipelineIntervals()
         clock: VirtualClock = cluster.clock
+
+        # Capacity economy (control/capacity.py): a CapacityConfig installs
+        # the bounded SlicePool + priority/fair-share/preemption scheduler
+        # (and optionally the simulated cluster-autoscaler) over the cluster
+        # BEFORE any pod schedules, so even the first reconcile is arbitrated.
+        self.capacity_scheduler = None
+        self.pool_metrics = None
+        if capacity is not None:
+            from k8s_gpu_hpa_tpu.control.capacity import (
+                PoolMetricsExporter,
+                build_capacity,
+            )
+
+            self.capacity_scheduler = build_capacity(cluster, capacity)
+            self.pool_metrics = PoolMetricsExporter(self.capacity_scheduler)
 
         # Durability wiring (ISSUE 4): a WriteAheadLog makes the TSDB
         # recoverable, a CheckpointStore makes the HPA's sync-to-sync state
@@ -142,9 +158,11 @@ class AutoscalingPipeline:
         else:
             exporter_fetch = cluster.exporter_fetch
             ksm_fetch = cluster.kube_state_metrics_text
-        for node_name in cluster.nodes:
+        self._exporter_fetch = exporter_fetch
+
+        def add_node_target(node_name: str) -> None:
             target = self.scraper.add_target(
-                lambda n=node_name: exporter_fetch(n),
+                lambda n=node_name: self._exporter_fetch(n),
                 name=f"exporter/{node_name}",
                 node=node_name,
             )
@@ -152,7 +170,34 @@ class AutoscalingPipeline:
                 target.trace_origin = (
                     lambda n=node_name: cluster.exporter_sample_span(n)
                 )
+
+        for node_name in cluster.nodes:
+            add_node_target(node_name)
+        # Nodes the cluster-autoscaler provisions later get a scrape target
+        # the moment they join; a reaped node's target goes with it (the
+        # sharded plane flattens its targets read-only — there, a reaped
+        # node's target simply starts failing, like any dead endpoint).
+        cluster.on_node_added.append(lambda node: add_node_target(node.name))
+
+        def drop_node_target(node_name: str) -> None:
+            targets = getattr(self.scraper, "targets", None)
+            if not isinstance(targets, list):
+                return
+            for target in list(targets):
+                if target.name == f"exporter/{node_name}":
+                    targets.remove(target)
+
+        cluster.on_node_removed.append(drop_node_target)
         self.scraper.add_target(ksm_fetch, name="kube-state-metrics")
+        if self.pool_metrics is not None:
+            from k8s_gpu_hpa_tpu.control.capacity import POOL_TARGET_NAME
+
+            self.scraper.add_target(
+                self.pool_metrics.families
+                if structured_scrapes
+                else self.pool_metrics.exposition,
+                name=POOL_TARGET_NAME,
+            )
         if self.selfmetrics is not None:
             # the pipeline scrapes its own self-metrics like any other target,
             # so they land in the same TSDB / dashboard / doctor probes
@@ -268,11 +313,86 @@ class AutoscalingPipeline:
             tracer=tracer,
             selfmetrics=self.selfmetrics,
             checkpoint_store=checkpoint_store,
+            capacity_probe=self._capacity_probe_for(deployment.name),
         )
         self.scale_history: list[tuple[float, int, int]] = []  # (ts, from, to)
         self.hpa.on_scale = lambda a, b: self.scale_history.append((clock.now(), a, b))
+        #: tenant deployment name -> its HPAController (add_tenant_hpa); the
+        #: primary deployment's controller stays ``self.hpa``
+        self.tenant_hpas: dict[str, HPAController] = {}
+        #: tenant name -> (ts, from, to) scale log, like ``scale_history``
+        self.tenant_scale_history: dict[str, list[tuple[float, int, int]]] = {}
         self._clock = clock
         self._started = False
+
+    def _capacity_probe_for(self, tenant: str):
+        """The per-tenant capacity probe an HPAController surfaces as
+        conditions — None when no capacity economy is installed."""
+        if self.capacity_scheduler is None:
+            return None
+        return lambda: self.capacity_scheduler.tenant_status(tenant)
+
+    def add_tenant_hpa(
+        self,
+        deployment: SimDeployment,
+        record: str | None = None,
+        target_value: float = 40.0,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        behavior: HPABehavior | None = None,
+        replica_quantum: int = 1,
+    ) -> HPAController:
+        """Wire one more tenant deployment through the SAME shared plane: its
+        own recorded rule (per-tenant metrics filtering via the app-label
+        join), its own adapter entry, and its own HPAController syncing on the
+        shared clock — N controllers arbitrated by one CapacityScheduler.
+        The deployment must already live in the cluster
+        (``cluster.add_deployment``)."""
+        name = deployment.name
+        if name in self.tenant_hpas or name == self.deployment.name:
+            raise ValueError(f"deployment {name} already has an HPA")
+        record = record or f"{name.replace('-', '_')}_tensorcore_avg"
+        rule = tpu_test_avg_rule(
+            app=deployment.app_label,
+            deployment=name,
+            namespace=deployment.namespace,
+            record=record,
+        )
+        self.evaluator.rules.append(rule)
+        self.adapter.rules[record] = AdapterRule(series=record)
+        ref = ObjectReference("Deployment", name, deployment.namespace)
+        hpa = HPAController(
+            target=deployment,
+            metrics=[ObjectMetricSpec(record, target_value, ref)],
+            adapter=self.adapter,
+            clock=self._clock,
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            behavior=behavior,
+            sync_interval=self.intervals.hpa_sync,
+            replica_quantum=replica_quantum,
+            pod_lister=deployment,
+            namespace=deployment.namespace,
+            tracer=self.tracer,
+            selfmetrics=self.selfmetrics,
+            capacity_probe=self._capacity_probe_for(name),
+        )
+        history: list[tuple[float, int, int]] = []
+        hpa.on_scale = lambda a, b, h=history: h.append((self._clock.now(), a, b))
+        self.tenant_scale_history[name] = history
+        self.tenant_hpas[name] = hpa
+        if self._started:
+            self._periodic(
+                self.intervals.hpa_sync,
+                lambda n=name: self.tenant_hpas[n].sync_once(),
+            )
+        return hpa
+
+    def tenant_replicas(self, name: str) -> int:
+        return self.cluster.deployments[name].replicas
+
+    def tenant_running(self, name: str) -> int:
+        return len(self.cluster.running_pods(name))
 
     @property
     def clock(self) -> VirtualClock:
@@ -292,6 +412,11 @@ class AutoscalingPipeline:
         self._periodic(self.intervals.scrape, lambda: self.scraper.scrape_once())
         self._periodic(self.intervals.rule_eval, lambda: self._rule_tick())
         self._periodic(self.intervals.hpa_sync, lambda: self.hpa.sync_once())
+        for name in self.tenant_hpas:
+            self._periodic(
+                self.intervals.hpa_sync,
+                lambda n=name: self.tenant_hpas[n].sync_once(),
+            )
 
     def _rule_tick(self) -> None:
         """One rule-eval tick: shard-local rules first (the federation
@@ -399,6 +524,7 @@ class AutoscalingPipeline:
             tracer=old.tracer,
             selfmetrics=old.selfmetrics,
             checkpoint_store=self.checkpoint_store,
+            capacity_probe=old.capacity_probe,
         )
         return self._log_restart(
             "hpa", {"checkpoint_restored": self.hpa.restored_from_checkpoint}

@@ -400,6 +400,43 @@ def check_downsampling(payload: str) -> str:
     )
 
 
+def check_capacity_pool(payload: str) -> str:
+    """Capacity-economy health (control/capacity.py): the slice pool's
+    accounting must be conserved — used + free == capacity, with zero
+    boundary violations — at EVERY tick of a canned mini-crunch, and at
+    least one preemption must round-trip its victim back to Running
+    (pending → admitted → preempted → re-admitted).  A conservation break
+    means chips leaked or were double-booked — every placement decision
+    downstream of the pool is then suspect; a missing round trip means
+    eviction-with-grace is silently deleting victims instead of re-queueing
+    them.  ``payload`` is ``capacity_selfcheck()`` JSON."""
+    doc = json.loads(payload)
+    if not doc.get("conserved_all", False) or doc.get("violations"):
+        broken = doc.get("violations", [])
+        raise AssertionError(
+            "pool accounting NOT conserved across "
+            f"{doc.get('ticks', 0)} tick(s): "
+            + ("; ".join(broken[:3]) or "used + free != capacity")
+            + " — chips leaked or double-booked; distrust every placement"
+        )
+    if not doc.get("preemption_roundtrip", False):
+        raise AssertionError(
+            "no preemption round-tripped its victim back to Running "
+            f"({doc.get('preemptions_total', 0)} preemption(s) recorded) — "
+            "eviction-with-grace is losing victims instead of re-queueing"
+        )
+    if doc.get("lo_running", 0) < 1 or doc.get("hi_running", 0) < 1:
+        raise AssertionError(
+            "crunch did not converge: lo_running="
+            f"{doc.get('lo_running', 0)}, hi_running={doc.get('hi_running', 0)}"
+            " — the provisioned node never re-admitted the victim"
+        )
+    return (
+        f"pool conserved over {doc['ticks']} tick(s), "
+        f"{doc['preemptions_total']} preemption(s) round-tripped to Running"
+    )
+
+
 def check_custom_metrics_api(payload: str, metric: str) -> str:
     """L4 joint: the aggregated API lists the metric (README.md:98-102)."""
     doc = json.loads(payload)
@@ -495,6 +532,7 @@ def diagnose(
     shards_fetch: Callable[[], str] | None = None,
     planner_fetch: Callable[[], str] | None = None,
     downsample_fetch: Callable[[], str] | None = None,
+    capacity_fetch: Callable[[], str] | None = None,
 ) -> list[ProbeResult]:
     """Run the ordered joint probes, stopping at the first failure (the
     runbook discipline).  Fetchers set to None are skipped — e.g. tests
@@ -548,6 +586,13 @@ def diagnose(
             "downsample tiers hold buckets, rollup folds bit-agree with raw",
             (lambda: check_downsampling(downsample_fetch()))
             if downsample_fetch
+            else None,
+        ),
+        (
+            "capacity pool",
+            "slice pool conserved every tick, preemptions round-trip victims",
+            (lambda: check_capacity_pool(capacity_fetch()))
+            if capacity_fetch
             else None,
         ),
         (

@@ -133,3 +133,39 @@ DOWNSAMPLE_SMOKE_MIN_ROLLUP_SPEEDUP = 3.0
 #: bucket at 1/10-1/120 the sample count); a tier accidentally storing
 #: per-sample rows would land near 1.0
 MAX_ROLLUP_BYTES_RATIO = 0.1
+
+# ---- capacity_crunch: the multi-tenant pool rung (ISSUE 9) ------------------
+
+#: base pool: 2 nodes x 8 chips; the autoscaler may add 2 more 8-chip nodes
+#: (whole 4-chip slice quanta), so peak supply is 32 chips against a peak
+#: three-tenant demand of ~31 — the crunch clears only if preemption,
+#: fair-share, and provisioning all do their jobs
+CRUNCH_BASE_NODES = 2
+CRUNCH_NODE_CHIPS = 8
+CRUNCH_SLICE_QUANTUM = 4
+CRUNCH_AUTOSCALER_MAX_NODES = 2
+CRUNCH_PROVISION_DELAY_S = 45.0
+CRUNCH_PROVISION_TIMEOUT_S = 60.0
+CRUNCH_EVICTION_GRACE_S = 10.0
+#: total virtual seconds after the faults arm (spikes clear at ~510 s;
+#: the tail is the convergence window the contract checks)
+CRUNCH_TOTAL_S = 1000.0
+
+#: per-priority time-to-capacity p95 ceilings (seconds a pod waits Pending
+#: before binding, over every admission in the run).  The high-priority
+#: tenant is served by preemption (eviction grace + requeue, measured p95
+#: ~10 s); the low-priority band must wait for the autoscaler to win its
+#: provision_fail backoff fight (measured p95 ~235-310 s) — gates carry
+#: margin over measured so scheduler regressions, not jitter, trip them
+CRUNCH_HIGH_TTC_P95_MAX_S = 60.0
+CRUNCH_LOW_TTC_P95_MAX_S = 480.0
+
+#: declared starvation budgets (longest tolerable single Pending stint);
+#: the contract fails any tenant whose worst stint exceeds its budget —
+#: and the ``simulate crunch --starvation-budget`` override exists exactly
+#: to prove the contract CAN fail (the deliberate-break acceptance test)
+CRUNCH_STARVATION_BUDGETS_S = {
+    "tpu-prod": 120.0,
+    "tpu-batch": 600.0,
+    "tpu-best": 900.0,
+}

@@ -995,6 +995,20 @@ def main(args) -> int:
             return 2
         return 0
 
+    if args.scenario == "crunch":
+        # the multi-tenant capacity crunch (chaos/crunch.py): three tenants
+        # spike into a bounded slice pool while provisioning fails and a
+        # node drains.  Exits non-zero on ANY capacity-contract violation —
+        # a broken pool audit, a starvation budget blown, an eviction over
+        # budget, or a crunch that never converged after clearing.
+        from k8s_gpu_hpa_tpu.chaos import render_crunch_report, run_capacity_crunch
+
+        result = run_capacity_crunch(
+            starvation_budget=getattr(args, "starvation_budget", None)
+        )
+        print(render_crunch_report(result))
+        return 0 if result["ok"] else 2
+
     if args.scenario == "drill":
         # recovery drill: kill each durable control-plane component mid-run
         # (TSDB -> WAL replay, HPA -> checkpoint restore, adapter rewire,
@@ -1197,6 +1211,7 @@ if __name__ == "__main__":
             "outage",
             "crash",
             "chaos",
+            "crunch",
             "trace",
             "drill",
             "slo",
@@ -1244,5 +1259,12 @@ if __name__ == "__main__":
         default=None,
         help="comma list of components the 'drill' scenario restarts "
         "(tsdb,hpa,adapter,wal); default all",
+    )
+    parser.add_argument(
+        "--starvation-budget",
+        type=float,
+        default=None,
+        help="override every tenant's starvation budget (seconds) for the "
+        "'crunch' scenario; 0 proves the contract can fail",
     )
     sys.exit(main(parser.parse_args()))

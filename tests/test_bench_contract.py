@@ -201,6 +201,17 @@ def test_time_budget_completes_unattended_with_labeled_skips():
     assert drill["all_recovered"] is True
     assert drill["spurious_scale_events_during_replay"] == 0
     assert drill["ok"] is True
+    # capacity_crunch rung contract: the pool audit held on every tick, the
+    # squeeze genuinely exercised preemption + provisioning failure, and the
+    # capacity contract (perfgates CRUNCH_*) reported zero violations
+    crunch = final["rungs"]["capacity_crunch"]
+    for key in ("ttc_p95_s", "max_pending_stint_s", "pool_conserved"):
+        assert key in crunch, f"capacity_crunch rung missing {key!r}"
+    assert crunch["pool_conserved"] is True
+    assert crunch["preemptions_total"] >= 1
+    assert crunch["provision_failures"] >= 1
+    assert crunch["violations"] == []
+    assert crunch["ok"] is True
     assert [c["pod_start_s"] for c in final["pod_start_sensitivity"]] == [
         12.0,
         30.0,

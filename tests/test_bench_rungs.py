@@ -301,3 +301,23 @@ def test_sim_scale_10k_rung_gates_compression_query_and_ring(monkeypatch):
     # bytes/sample beats the uncompressed 16-byte pair by the gate margin
     assert result["bytes_per_sample"] <= 16.0 / result["compression_floor"]
     assert result["ok"] is True
+
+
+def test_capacity_crunch_rung_gates_the_full_contract():
+    """The capacity-economy rung (chaos/crunch.py): the canned three-tenant
+    crunch must hold every contract clause AND be non-vacuous — a run with
+    no preemption, no provision, or no provision failure proves nothing
+    about the economy it claims to gate."""
+    result = bench.run_rung_capacity_crunch()
+    assert result["mode"] == "virtual"
+    assert result["pool_conserved"] is True and result["audit_ticks"] > 0
+    assert result["all_recovered"] is True
+    assert result["preemptions_total"] >= 1
+    assert result["provisions"] >= 1 and result["provision_failures"] >= 1
+    # the top band is served by preemption (seconds), the low band by
+    # provisioning (minutes) — the priority economy must be visible in TTC
+    assert result["ttc_p95_s"]["tpu-prod"] < result["ttc_p95_s"]["tpu-batch"]
+    # prod's preemption budget is 0: it must never appear as a victim
+    assert result["preemptions"]["tpu-prod"] == 0
+    assert result["violations"] == []
+    assert result["ok"] is True

@@ -1,0 +1,762 @@
+"""The capacity economy (control/capacity.py) and the crunch that scores it.
+
+Four layers of coverage, cheapest first:
+
+- **pool invariants**: ``SlicePool.audit`` proves conservation and the
+  node-is-the-slice-boundary rule on live clusters AND catches doctored
+  corruption (orphan chips, split pods, off-quantum nodes);
+- **the scheduler ladder**: priority admission, the yield walk (with its
+  backfill escape), the fair-share gate, eviction-with-grace round trips,
+  preemption budgets, and the simulated cluster-autoscaler's delay /
+  timeout / backoff / reap behavior — each driven directly on a cluster;
+- **pipeline integration**: pool self-metrics riding the shared scrape
+  plane into the TSDB, per-tenant Unschedulable / Preempting /
+  FairShareLimited HPA conditions, N-controller wiring, and the
+  multi-tenant regressions (exporter attribution, kill isolation,
+  per-tenant last_reason, chaos health across ALL tenants);
+- **the crunch contract**: one full ``run_capacity_crunch`` (module-scoped
+  — it is the expensive fixture), its deliberate-break knob, the CLI exit
+  code, and ``evaluate_crunch_contract`` clause-by-clause over doctored
+  results, so every way the contract can fail is proven to fire.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+import pytest
+
+from k8s_gpu_hpa_tpu.control.capacity import (
+    POOL_CAPACITY_CHIPS,
+    POOL_METRIC_NAMES,
+    POOL_TARGET_NAME,
+    POOL_USED_CHIPS,
+    CapacityConfig,
+    SlicePool,
+    TenantSpec,
+    build_capacity,
+    capacity_selfcheck,
+)
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+def make_cluster(nodes=None, latency=2.0):
+    clock = VirtualClock()
+    cluster = SimCluster(
+        clock, nodes=nodes or [("tpu-node-0", 4)], pod_start_latency=latency
+    )
+    return clock, cluster
+
+
+def add_tenant(cluster, name, chips, replicas, load=0.0):
+    dep = SimDeployment(
+        cluster, name, name, chips_per_pod=chips, load_fn=lambda t: load
+    )
+    cluster.add_deployment(dep, replicas=replicas)
+    return dep
+
+
+# ---- TenantSpec / SlicePool invariants -------------------------------------
+
+
+def test_tenant_spec_rejects_bad_weight_and_budget():
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec("t", weight=0.0)
+    with pytest.raises(ValueError, match="preemption_budget"):
+        TenantSpec("t", preemption_budget=-1)
+    with pytest.raises(ValueError, match="slice_quantum"):
+        SlicePool(SimCluster(VirtualClock()), slice_quantum=0)
+
+
+def test_pool_audit_conserved_on_live_cluster():
+    clock, cluster = make_cluster(nodes=[("n0", 4), ("n1", 4)])
+    build_capacity(cluster, CapacityConfig(slice_quantum=4))
+    add_tenant(cluster, "a", 2, replicas=2)
+    add_tenant(cluster, "b", 1, replicas=3)
+    clock.advance(10.0)
+    audit = cluster.scheduler.pool.audit()
+    assert audit["conserved"] and not audit["violations"]
+    assert audit["capacity"] == 8
+    assert audit["used"] == 2 * 2 + 3 * 1
+    assert audit["used"] + audit["free"] == audit["capacity"]
+
+
+def test_pool_audit_catches_orphan_chip():
+    clock, cluster = make_cluster()
+    pool = SlicePool(cluster)
+    cluster.nodes["tpu-node-0"].allocations[0] = "ghost-pod"
+    audit = pool.audit()
+    assert not audit["conserved"]
+    assert any("missing pod ghost-pod" in v for v in audit["violations"])
+
+
+def test_pool_audit_catches_split_pod():
+    clock, cluster = make_cluster()
+    pool = SlicePool(cluster)
+    add_tenant(cluster, "a", 2, replicas=1)
+    clock.advance(5.0)
+    pod = next(iter(cluster.pods.values()))
+    pod.chip_ids = pod.chip_ids[:1]  # pod now holds fewer chips than requested
+    audit = pool.audit()
+    assert not audit["conserved"]
+    assert any("requested 2" in v for v in audit["violations"])
+
+
+def test_pool_audit_catches_off_quantum_node():
+    clock, cluster = make_cluster(nodes=[("n0", 6)])
+    audit = SlicePool(cluster, slice_quantum=4).audit()
+    assert not audit["conserved"]
+    assert any("whole number of slice quanta" in v for v in audit["violations"])
+
+
+# ---- the scheduler ladder ---------------------------------------------------
+
+
+def test_priority_admission_and_no_upward_preemption():
+    """Both tenants contend for one 4-chip node: the high-priority tenant's
+    pods admit first, and the low one can never preempt upward."""
+    clock, cluster = make_cluster()
+    build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[
+                TenantSpec("hi", priority=100),
+                TenantSpec("lo", priority=0, preemption_budget=4),
+            ]
+        ),
+    )
+    add_tenant(cluster, "lo", 2, replicas=2)  # created FIRST, attempts first
+    add_tenant(cluster, "hi", 2, replicas=2)
+    clock.advance(30.0)
+    assert len(cluster.running_pods("hi")) == 2
+    assert len(cluster.running_pods("lo")) == 0
+    assert len(cluster.scheduler.pending_pods("lo")) == 2
+    assert cluster.scheduler.preemptions_total == 0
+
+
+def test_yield_walk_reserves_chips_for_more_deserving_pod():
+    """A fitting higher-priority pending pod's claim is reserved: the lower
+    one may not grab chips out from under it, even if its requeue timer
+    fires first."""
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[TenantSpec("hi", priority=100), TenantSpec("lo", priority=0)]
+        ),
+    )
+    filler = add_tenant(cluster, "filler", 4, replicas=1)
+    clock.advance(5.0)
+    add_tenant(cluster, "hi", 4, replicas=1)  # pends behind the filler
+    add_tenant(cluster, "lo", 2, replicas=1)  # pends too
+    clock.advance(5.0)
+    filler.scale_to(0)  # 4 chips free at once; both requeues race
+    clock.advance(30.0)
+    assert len(cluster.running_pods("hi")) == 1
+    assert len(cluster.running_pods("lo")) == 0, "lo stole the hi pod's claim"
+    assert scheduler.pending_pods("lo")
+
+
+def test_yield_walk_backfills_past_unfittable_pod():
+    """A more deserving pod that fits NOWHERE reserves nothing — the small
+    pod backfills instead of idling chips behind an impossible claim."""
+    clock, cluster = make_cluster()
+    build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[TenantSpec("hi", priority=100), TenantSpec("lo", priority=0)]
+        ),
+    )
+    add_tenant(cluster, "hi", 8, replicas=1)  # can never fit on a 4-chip node
+    add_tenant(cluster, "lo", 2, replicas=1)
+    clock.advance(30.0)
+    assert len(cluster.running_pods("lo")) == 1
+    assert cluster.scheduler.pending_pods("hi")
+
+
+def test_fair_share_gate_holds_over_share_tenant():
+    """Same priority band, weights 1:1 over 4 chips (2-chip shares): the
+    tenant already at 4 chips wanting more must yield to the peer waiting
+    under its share — flagged, evented, and never served by preemption."""
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[
+                TenantSpec("a", priority=10, weight=1.0, preemption_budget=4),
+                TenantSpec("b", priority=10, weight=1.0, preemption_budget=4),
+            ]
+        ),
+    )
+    a = add_tenant(cluster, "a", 2, replicas=2)  # fills the node
+    clock.advance(10.0)
+    add_tenant(cluster, "b", 2, replicas=1)  # pends under its share
+    a.scale_to(3)  # a, over share, asks for even more
+    clock.advance(30.0)
+    assert scheduler.fair_share_limited["a"] is True
+    assert scheduler.tenant_status("a")["fair_share_limited"] is True
+    assert any(
+        e["event"] == "fair_share_limited" and e["tenant"] == "a"
+        for e in scheduler.events
+    )
+    # the gate forbids preemption on a's behalf — same band, no victims
+    assert scheduler.preemptions_total == 0
+
+
+def test_eviction_grace_roundtrip_and_conservation():
+    """The full preemption story: the victim turns Terminating but HOLDS its
+    chips through the grace window (the pool stays conserved), then
+    re-queues and — once the autoscaled node lands — returns to Running.
+    Its event trail reads admitted → preempted → evicted → readmitted."""
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[
+                TenantSpec("hi", priority=100, preemption_budget=0),
+                TenantSpec("lo", priority=0, preemption_budget=4),
+            ],
+            slice_quantum=4,
+            grace_s=4.0,
+            autoscaler_node_chips=4,
+            autoscaler_max_nodes=1,
+            provision_delay_s=20.0,
+        ),
+    )
+    add_tenant(cluster, "lo", 4, replicas=1)
+    clock.advance(10.0)
+    assert len(cluster.running_pods("lo")) == 1
+    add_tenant(cluster, "hi", 4, replicas=1)
+    # the hi pod's first placement attempt (pod_start_latency 2 s) triggers
+    # the eviction; land 1 s into the 4 s grace window
+    clock.advance(3.0)
+    victim = cluster.deployment_pods("lo")[0]
+    assert victim.phase == "Terminating"
+    assert len(victim.chip_ids) == 4, "victim must hold chips through grace"
+    audit = scheduler.pool.audit()
+    assert audit["conserved"] and audit["used"] == 4
+    clock.advance(5.0)  # grace elapses
+    assert victim.phase in ("Pending", "Running")
+    clock.advance(40.0)  # provisioning + re-admission
+    assert len(cluster.running_pods("hi")) == 1
+    assert len(cluster.running_pods("lo")) == 1
+    lo_events = [e["event"] for e in scheduler.events if e["tenant"] == "lo"]
+    for earlier, later in zip(
+        ["admitted", "preempted", "evicted", "readmitted"],
+        ["preempted", "evicted", "readmitted", "readmitted"],
+    ):
+        assert lo_events.index(earlier) <= lo_events.index(later)
+    assert scheduler.preemptions_total == 1
+    assert scheduler.pool.audit()["conserved"]
+
+
+def test_preemption_budget_zero_is_never_evicted():
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            tenants=[
+                TenantSpec("hi", priority=100),
+                TenantSpec("lo", priority=0, preemption_budget=0),
+            ]
+        ),
+    )
+    add_tenant(cluster, "lo", 4, replicas=1)
+    clock.advance(10.0)
+    add_tenant(cluster, "hi", 4, replicas=1)
+    clock.advance(60.0)
+    assert len(cluster.running_pods("lo")) == 1, "budget-0 tenant was evicted"
+    assert scheduler.preemptions_total == 0
+    assert scheduler.pending_pods("hi")
+
+
+# ---- the cluster autoscaler -------------------------------------------------
+
+
+def test_autoscaler_provisions_whole_node_after_delay():
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            slice_quantum=4,
+            autoscaler_node_chips=8,
+            autoscaler_max_nodes=1,
+            provision_delay_s=30.0,
+        ),
+    )
+    auto = scheduler.autoscaler
+    auto.request()
+    auto.request()  # in flight: second call is a no-op, not a second node
+    clock.advance(29.0)
+    assert len(cluster.nodes) == 1
+    clock.advance(2.0)
+    assert len(cluster.nodes) == 2
+    assert cluster.nodes["tpu-auto-0"].num_chips == 8
+    assert auto.provisions_total == 1
+    auto.request()  # at max_nodes: ignored
+    clock.advance(60.0)
+    assert auto.provisions_total == 1
+    assert scheduler.pool.audit()["conserved"]
+
+
+def test_autoscaler_failure_timeout_and_exponential_backoff():
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            autoscaler_node_chips=4,
+            provision_delay_s=10.0,
+            provision_timeout_s=20.0,
+            backoff_base_s=30.0,
+            backoff_cap_s=480.0,
+        ),
+    )
+    auto = scheduler.autoscaler
+    auto.failing = True
+    auto.request()
+    clock.advance(19.0)
+    assert auto.provision_failures_total == 0, "failure fires at the TIMEOUT"
+    clock.advance(2.0)
+    assert auto.provision_failures_total == 1
+    assert auto.backoff_until == pytest.approx(clock.now() + 30.0, abs=1.5)
+    auto.request()  # inside backoff: ignored
+    assert not auto.in_flight
+    clock.advance(31.0)
+    auto.request()
+    clock.advance(21.0)
+    assert auto.provision_failures_total == 2
+    assert auto.backoff_until == pytest.approx(clock.now() + 60.0, abs=1.5)
+    # recovery resets the failure streak
+    auto.failing = False
+    clock.advance(61.0)
+    auto.request()
+    clock.advance(11.0)
+    assert auto.provisions_total == 1
+    assert auto.consecutive_failures == 0
+
+
+def test_reap_idle_removes_only_empty_autoscaled_nodes():
+    clock, cluster = make_cluster()
+    scheduler = build_capacity(
+        cluster,
+        CapacityConfig(
+            autoscaler_node_chips=4, autoscaler_max_nodes=2, provision_delay_s=5.0
+        ),
+    )
+    auto = scheduler.autoscaler
+    auto.request()
+    clock.advance(6.0)
+    add_tenant(cluster, "a", 4, replicas=2)  # one pod lands on the new node
+    clock.advance(10.0)
+    assert not auto.reap_idle(idle_s=0.0), "a chip-holding node was reaped"
+    cluster.deployments["a"].scale_to(0)
+    clock.advance(1.0)
+    assert auto.reap_idle(idle_s=0.0) == ["tpu-auto-0"]
+    assert "tpu-auto-0" not in cluster.nodes
+    # the base node is NEVER the autoscaler's to reap
+    assert "tpu-node-0" in cluster.nodes
+
+
+def test_node_lifecycle_guards():
+    clock, cluster = make_cluster()
+    add_tenant(cluster, "a", 2, replicas=1)
+    clock.advance(5.0)
+    with pytest.raises(ValueError, match="already exists"):
+        cluster.add_node("tpu-node-0", 4)
+    with pytest.raises(ValueError, match="allocated"):
+        cluster.remove_node("tpu-node-0")
+    with pytest.raises(KeyError):
+        cluster.remove_node("no-such-node")
+    with pytest.raises(ValueError, match="whole number of slice quanta"):
+        build_capacity(
+            cluster, CapacityConfig(slice_quantum=4, autoscaler_node_chips=6)
+        )
+
+
+# ---- pipeline integration ---------------------------------------------------
+
+
+def make_capacity_pipeline(latency=2.0, grace_s=30.0):
+    """One 4-chip node, a high-priority primary tenant and a low-priority
+    second tenant whose demand overflows the pool — the smallest topology
+    where every capacity condition is reachable."""
+    clock, cluster = make_cluster(latency=latency)
+    state = {"hi": 30.0, "lo": 90.0}
+    hi = SimDeployment(
+        cluster, "tpu-test", "tpu-test", chips_per_pod=2,
+        load_fn=lambda t: state["hi"], load_mode="shared",
+    )
+    cluster.add_deployment(hi, replicas=1)
+    clock.advance(5.0)
+    pipe = AutoscalingPipeline(
+        cluster,
+        hi,
+        target_value=40.0,
+        max_replicas=2,
+        capacity=CapacityConfig(
+            tenants=[
+                TenantSpec("tpu-test", priority=100, preemption_budget=0),
+                TenantSpec("tpu-lo", priority=0, preemption_budget=4),
+            ],
+            grace_s=grace_s,
+        ),
+    )
+    lo = SimDeployment(
+        cluster, "tpu-lo", "tpu-lo", chips_per_pod=2,
+        load_fn=lambda t: state["lo"], load_mode="shared",
+    )
+    cluster.add_deployment(lo, replicas=1)
+    pipe.add_tenant_hpa(lo, target_value=40.0, max_replicas=2)
+    pipe.start()
+    return clock, pipe, state
+
+
+def test_pool_metrics_ride_the_shared_scrape_plane():
+    clock, pipe, state = make_capacity_pipeline()
+    assert any(t.name == POOL_TARGET_NAME for t in pipe.scraper.targets)
+    text = pipe.pool_metrics.exposition()
+    for name in POOL_METRIC_NAMES:
+        assert name in text
+    clock.advance(60.0)
+    assert pipe.db.latest(POOL_CAPACITY_CHIPS) == 4.0
+    assert pipe.db.latest(POOL_USED_CHIPS) == float(
+        pipe.capacity_scheduler.pool.used()
+    )
+
+
+def test_autoscaled_node_joins_and_leaves_the_scrape_plane():
+    clock, cluster = make_cluster()
+    dep = add_tenant(cluster, "tpu-test", 2, replicas=1)
+    pipe = AutoscalingPipeline(
+        cluster,
+        dep,
+        capacity=CapacityConfig(autoscaler_node_chips=4, provision_delay_s=5.0),
+    )
+    pipe.start()
+    auto = pipe.capacity_scheduler.autoscaler
+    auto.request()
+    clock.advance(20.0)
+    names = [t.name for t in pipe.scraper.targets]
+    assert "exporter/tpu-auto-0" in names
+    assert pipe.db.latest("up", {"target": "exporter/tpu-auto-0"}) == 1.0
+    assert auto.reap_idle(idle_s=0.0) == ["tpu-auto-0"]
+    assert "exporter/tpu-auto-0" not in [t.name for t in pipe.scraper.targets]
+
+
+def test_unschedulable_and_preempting_conditions_surface():
+    clock, pipe, state = make_capacity_pipeline()
+    clock.advance(60.0)
+    # lo wants 2 replicas (load 90 over target 40) but the primary holds 2 of
+    # 4 chips: one lo pod pends -> its own HPA says Unschedulable
+    lo_hpa = pipe.tenant_hpas["tpu-lo"]
+    cond = lo_hpa.status.condition("Unschedulable")
+    assert cond is not None and cond.status is True
+    assert "awaiting pool capacity" in cond.message
+    hi_cond = pipe.hpa.status.condition("Unschedulable")
+    assert hi_cond is not None and hi_cond.status is False
+    # now the primary spikes: its second pod preempts a lo victim, and with a
+    # 30 s grace the next sync lands INSIDE the eviction window
+    state["hi"] = 90.0
+    clock.advance(40.0)
+    pre = pipe.hpa.status.condition("Preempting")
+    assert pre is not None and pre.status is True
+    assert "eviction grace" in pre.message
+    assert pipe.capacity_scheduler.preemptions_suffered["tpu-lo"] >= 1
+    clock.advance(60.0)  # grace over, victim requeued, eviction done
+    pre = pipe.hpa.status.condition("Preempting")
+    assert pre.status is False
+
+
+def test_fair_share_limited_condition_tracks_probe():
+    clock, pipe, state = make_capacity_pipeline()
+    probe = {"pending_pods": 0, "evictions_in_flight": 0, "fair_share_limited": True}
+    pipe.hpa.capacity_probe = lambda: probe
+    pipe.hpa.sync_once()
+    cond = pipe.hpa.status.condition("FairShareLimited")
+    assert cond.status is True and cond.reason == "OverFairShare"
+    probe["fair_share_limited"] = False
+    pipe.hpa.sync_once()
+    assert pipe.hpa.status.condition("FairShareLimited").status is False
+
+
+def test_add_tenant_hpa_rejects_duplicates():
+    clock, pipe, state = make_capacity_pipeline()
+    with pytest.raises(ValueError, match="already has an HPA"):
+        pipe.add_tenant_hpa(pipe.cluster.deployments["tpu-lo"])
+    with pytest.raises(ValueError, match="already has an HPA"):
+        pipe.add_tenant_hpa(pipe.deployment)
+
+
+def test_restart_hpa_keeps_the_capacity_probe():
+    clock, pipe, state = make_capacity_pipeline()
+    clock.advance(60.0)
+    assert pipe.hpa.capacity_probe is not None
+    pipe.restart_hpa()
+    assert pipe.hpa.capacity_probe is not None
+    clock.advance(30.0)
+    assert pipe.hpa.status.condition("Unschedulable") is not None
+
+
+# ---- multi-tenant regressions (the latent single-tenant assumptions) --------
+
+
+def test_exporter_attributes_chips_to_the_right_tenant():
+    clock, cluster = make_cluster(nodes=[("n0", 4)])
+    add_tenant(cluster, "alpha", 2, replicas=1, load=50.0)
+    add_tenant(cluster, "beta", 2, replicas=1, load=50.0)
+    clock.advance(10.0)
+    text = cluster.exporter_fetch("n0")
+    alpha_pod = cluster.running_pods("alpha")[0].name
+    beta_pod = cluster.running_pods("beta")[0].name
+    assert f'pod="{alpha_pod}"' in text
+    assert f'pod="{beta_pod}"' in text
+
+
+def test_kill_pod_stays_inside_its_tenant():
+    clock, cluster = make_cluster(nodes=[("n0", 8)])
+    build_capacity(cluster, CapacityConfig())
+    add_tenant(cluster, "alpha", 2, replicas=2)
+    add_tenant(cluster, "beta", 2, replicas=2)
+    clock.advance(10.0)
+    beta_before = {p.name for p in cluster.running_pods("beta")}
+    cluster.kill_pod(cluster.running_pods("alpha")[0].name)
+    assert {p.name for p in cluster.running_pods("beta")} == beta_before
+    clock.advance(10.0)  # the replacement pod is alpha's, not beta's
+    assert len(cluster.running_pods("alpha")) == 2
+    assert len(cluster.running_pods("beta")) == 2
+    assert cluster.scheduler.pool.audit()["conserved"]
+
+
+def test_per_tenant_hpas_keep_independent_reasons_and_histories():
+    clock, pipe, state = make_capacity_pipeline()
+    clock.advance(90.0)
+    assert pipe.hpa.status.last_reason
+    assert pipe.tenant_hpas["tpu-lo"].status.last_reason
+    # each controller reasons over ITS OWN recorded metric, not the primary's
+    assert set(pipe.hpa.status.last_metric_values) == {"tpu_test_tensorcore_avg"}
+    assert set(pipe.tenant_hpas["tpu-lo"].status.last_metric_values) == {
+        "tpu_lo_tensorcore_avg"
+    }
+    # lo scaled up (its own history), the primary held steady
+    assert pipe.tenant_scale_history["tpu-lo"]
+    assert pipe.tenant_replicas("tpu-lo") == 2
+    assert pipe.tenant_running("tpu-lo") >= 1
+    assert not pipe.scale_history, "primary logged a tenant's scale event"
+
+
+def test_chaos_health_covers_every_tenant():
+    from k8s_gpu_hpa_tpu.chaos.faults import FaultSpec
+    from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule
+
+    clock, pipe, state = make_capacity_pipeline()
+    clock.advance(60.0)
+    schedule = ChaosSchedule(pipe, [FaultSpec("pod_crash", at=1e9)])
+    # the second tenant has a pod pending (pool full) -> NOT healthy, even
+    # though the primary deployment alone looks converged
+    assert len(pipe.cluster.running_pods("tpu-test")) == pipe.deployment.replicas
+    assert not schedule._healthy()
+    # shrink the second tenant so everything fits -> healthy
+    pipe.tenant_hpas["tpu-lo"].max_replicas = 1
+    pipe.cluster.deployments["tpu-lo"].scale_to(1)
+    clock.advance(60.0)
+    assert schedule._healthy()
+
+
+# ---- the crunch contract ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def crunch_result():
+    from k8s_gpu_hpa_tpu.chaos import run_capacity_crunch
+
+    return run_capacity_crunch()
+
+
+def test_crunch_contract_holds(crunch_result):
+    assert crunch_result["violations"] == []
+    assert crunch_result["ok"] is True
+    assert crunch_result["pool"]["conserved_all"] is True
+    # non-vacuity: the economy was actually squeezed
+    assert crunch_result["preemptions_total"] >= 1
+    assert crunch_result["autoscaler"]["provisions"] >= 1
+    assert crunch_result["autoscaler"]["provision_failures"] >= 1
+    assert crunch_result["all_recovered"] is True
+
+
+def test_crunch_priorities_played_out(crunch_result):
+    tenants = crunch_result["tenants"]
+    # prod's budget is 0: it was never evicted, and preemption served it far
+    # faster than provisioning served the low band
+    assert tenants["tpu-prod"]["preemptions_suffered"] == 0
+    assert tenants["tpu-prod"]["ttc_p95_s"] <= tenants["tpu-batch"]["ttc_p95_s"]
+    events = {e["event"] for e in crunch_result["events"]}
+    assert {"preempted", "evicted", "readmitted", "fair_share_limited"} <= events
+    for t in tenants.values():
+        assert t["preemptions_suffered"] <= t["preemption_budget"]
+        assert t["max_pending_stint_s"] <= t["starvation_budget_s"]
+
+
+def test_crunch_report_renders(crunch_result):
+    from k8s_gpu_hpa_tpu.chaos import render_crunch_report
+
+    text = render_crunch_report(crunch_result)
+    assert "contract: all clauses hold" in text
+    assert "tpu-prod" in text and "tpu-batch" in text and "tpu-best" in text
+    assert "timeline" in text
+
+
+def test_crunch_deliberate_break_exits_nonzero(capsys):
+    """The acceptance clause: a deliberately broken contract (starvation
+    budget 0 fails any run that ever queued a pod) must exit non-zero
+    through the CLI and name the violated clause."""
+    from k8s_gpu_hpa_tpu.__main__ import main
+
+    rc = main(["simulate", "--scenario", "crunch", "--starvation-budget", "0"])
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert "CONTRACT VIOLATIONS:" in out
+    assert "over its 0s budget" in out
+
+
+def _passing_result() -> dict:
+    """The minimal result dict evaluate_crunch_contract scores clean."""
+    return {
+        "pool": {"conserved_all": True, "audit_violations": []},
+        "tenants": {
+            "t": {
+                "ttc_p95_s": 10.0,
+                "ttc_gate_s": 60.0,
+                "max_pending_stint_s": 5.0,
+                "starvation_budget_s": 120.0,
+                "preemptions_suffered": 1,
+                "preemption_budget": 2,
+                "final_running": 1,
+                "final_replicas": 1,
+                "final_pending": 0,
+                "final_terminating": 0,
+            }
+        },
+        "all_recovered": True,
+        "autoscaler": {"nodes_final": 0, "provisions": 1, "provision_failures": 1},
+        "preemptions_total": 1,
+    }
+
+
+@pytest.mark.parametrize(
+    "doctor,expect",
+    [
+        (lambda r: r["pool"].update(conserved_all=False), "conservation broken"),
+        (lambda r: r["tenants"]["t"].update(ttc_p95_s=61.0), "exceeds the 60s gate"),
+        (
+            lambda r: r["tenants"]["t"].update(max_pending_stint_s=121.0),
+            "over its 120s budget",
+        ),
+        (
+            lambda r: r["tenants"]["t"].update(preemptions_suffered=3),
+            "over its budget of 2",
+        ),
+        (lambda r: r["tenants"]["t"].update(final_pending=1), "did not converge"),
+        (lambda r: r.update(all_recovered=False), "not every fault recovered"),
+        (lambda r: r["autoscaler"].update(nodes_final=1), "never reaped"),
+        (lambda r: r.update(preemptions_total=0), "no preemption ever"),
+        (lambda r: r["autoscaler"].update(provisions=0), "never provisioned"),
+        (
+            lambda r: r["autoscaler"].update(provision_failures=0),
+            "provision_fail never bit",
+        ),
+    ],
+)
+def test_contract_clause_fires(doctor, expect):
+    from k8s_gpu_hpa_tpu.chaos import evaluate_crunch_contract
+
+    result = copy.deepcopy(_passing_result())
+    assert evaluate_crunch_contract(result) == []
+    doctor(result)
+    violations = evaluate_crunch_contract(result)
+    assert len(violations) == 1 and expect in violations[0]
+
+
+# ---- the doctor probe -------------------------------------------------------
+
+
+def test_check_capacity_pool_passes_on_selfcheck():
+    from k8s_gpu_hpa_tpu.doctor import check_capacity_pool
+
+    payload = json.dumps(capacity_selfcheck())
+    msg = check_capacity_pool(payload)
+    assert "pool conserved" in msg
+    assert "round-tripped to Running" in msg
+
+
+@pytest.mark.parametrize(
+    "patch,expect",
+    [
+        ({"conserved_all": False}, "NOT conserved"),
+        ({"violations": ["node n0: used 3 + free 2 != capacity 4"]}, "NOT conserved"),
+        ({"preemption_roundtrip": False}, "losing victims"),
+        ({"lo_running": 0}, "did not converge"),
+    ],
+)
+def test_check_capacity_pool_failure_modes(patch, expect):
+    from k8s_gpu_hpa_tpu.doctor import check_capacity_pool
+
+    doc = {
+        "ticks": 10,
+        "conserved_all": True,
+        "violations": [],
+        "preemption_roundtrip": True,
+        "lo_running": 1,
+        "hi_running": 1,
+        "preemptions_total": 1,
+    }
+    doc.update(patch)
+    with pytest.raises(AssertionError, match=expect):
+        check_capacity_pool(json.dumps(doc))
+
+
+def test_diagnose_runs_the_capacity_probe():
+    from k8s_gpu_hpa_tpu.doctor import diagnose
+
+    results = diagnose(
+        capacity_fetch=lambda: json.dumps(capacity_selfcheck())
+    )
+    by_name = {r.name: r for r in results}
+    assert by_name["capacity pool"].ok
+
+
+# ---- the fault-registry lint ------------------------------------------------
+
+
+def test_lint_faults_requires_a_natural_spec_row(tmp_path):
+    """Satellite guarantee: a registered fault kind missing from the
+    NATURAL_SPECS parametrization table fails the lint, even when some
+    other test file happens to mention the kind's name."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from lint_faults import lint_fault_kinds
+    finally:
+        sys.path.pop(0)
+    from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS
+
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    all_kinds = sorted(FAULT_KINDS)
+    mentions = "\n".join(f"# {kind}" for kind in all_kinds)
+    rows = "\n".join(
+        f'    "{kind}": dict(),' for kind in all_kinds if kind != "provision_fail"
+    )
+    (tests_dir / "test_fault_injectors.py").write_text(
+        f"{mentions}\nNATURAL_SPECS = {{\n{rows}\n}}\n"
+    )
+    errors = lint_fault_kinds(tests_dir=tests_dir)
+    assert any("provision_fail" in e and "NATURAL_SPECS" in e for e in errors)
+    assert not any(
+        "NATURAL_SPECS" in e and "provision_fail" not in e for e in errors
+    )
+    # the REAL tests directory is clean
+    assert not any("NATURAL_SPECS" in e for e in lint_fault_kinds())

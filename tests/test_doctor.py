@@ -150,9 +150,9 @@ def test_diagnose_stops_at_first_broken_joint():
 def test_diagnose_skips_absent_fetchers():
     results = diagnose(exporter_fetch=lambda: exposition())
     # L2 + L3 + L3 scrape health + L3 shard topology + L3 self-metrics
-    # + L3 histograms + L3 query planner + L3 rollup tiers + L4 + L5
-    # + operator + alerts
-    assert [r.ok for r in results] == [True] * 12
+    # + L3 histograms + L3 query planner + L3 rollup tiers + capacity pool
+    # + L4 + L5 + operator + alerts
+    assert [r.ok for r in results] == [True] * 13
     assert results[1].detail.startswith("skipped")
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import pytest
 
 from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, FaultSpec
+from k8s_gpu_hpa_tpu.control.capacity import CapacityConfig, TenantSpec
 from k8s_gpu_hpa_tpu.control.checkpoint import InMemoryCheckpointStore
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
 from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
@@ -49,6 +50,16 @@ def make_durable_pipeline(tmp_path):
         max_replicas=4,
         wal=WriteAheadLog(tmp_path / "wal", segment_max_records=256),
         checkpoint_store=InMemoryCheckpointStore(),
+        # a minimal capacity economy so the provision_fail injector has a
+        # cluster autoscaler to break (and every other fault runs against
+        # the arbitrated scheduler path, not just naive first-fit)
+        capacity=CapacityConfig(
+            tenants=[TenantSpec("tpu-test")],
+            autoscaler_node_chips=4,
+            autoscaler_max_nodes=1,
+            provision_delay_s=20.0,
+            provision_timeout_s=15.0,
+        ),
     )
     pipe.start()
     clock.advance(60.0)  # settle: running pods, WAL records, checkpoints
@@ -70,6 +81,8 @@ NATURAL_SPECS: dict[str, dict] = {
     "hpa_restart": dict(),
     "adapter_restart": dict(),
     "wal_truncate": dict(params={"records": 8}),
+    "tenant_spike": dict(duration=10.0, params={"add": 60.0}),
+    "provision_fail": dict(duration=10.0),
 }
 
 RESTART_KINDS = {"tsdb_restart", "hpa_restart", "adapter_restart", "wal_truncate"}

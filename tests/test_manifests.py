@@ -180,6 +180,7 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
     assert doc["metadata"]["labels"]["grafana_dashboard"] == "1"  # sidecar opt-in
     dash = json.loads(doc["data"]["tpu-hpa-pipeline.json"])
 
+    from k8s_gpu_hpa_tpu.control.capacity import POOL_METRIC_NAMES
     from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
     from k8s_gpu_hpa_tpu.obs.selfmetrics import (
         SELF_HISTOGRAM_SERIES,
@@ -226,6 +227,9 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         # (obs/slo.py) the burn panels and burn alerts read
         | set(SELF_HISTOGRAM_SERIES)
         | {SLO_GOOD_TOTAL, SLO_EVENTS_TOTAL}
+        # capacity-pool self-metrics (control/capacity.py, the capacity-pool
+        # scrape target) — single-sourced so a rename breaks this test
+        | set(POOL_METRIC_NAMES)
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
     assert exprs, "dashboard has no queries"

@@ -28,6 +28,15 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from k8s_gpu_hpa_tpu.control.capacity import (  # noqa: E402
+    POOL_CAPACITY_CHIPS,
+    POOL_PENDING_PODS,
+    POOL_PENDING_SECONDS,
+    POOL_PREEMPTIONS,
+    POOL_PROVISION_FAILURES,
+    POOL_PROVISIONED_NODES,
+    POOL_USED_CHIPS,
+)
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
 from k8s_gpu_hpa_tpu.obs.selfmetrics import (  # noqa: E402
     ADAPTER_QUERY_LATENCY,
@@ -681,6 +690,77 @@ def build_dashboard() -> dict:
             "Plans sharing boundary chunks reuse each other's decodes; a "
             "miss-dominated panel under a steady rule set means the cache "
             "is thrashing (too many distinct chunks in the hot window).",
+        ),
+        # ---- capacity economy (control/capacity.py): the bounded slice
+        # pool, served by the capacity-pool scrape target ----
+        _ts_panel(
+            32,
+            "Capacity pool: chips used vs capacity",
+            0,
+            120,
+            [
+                _target(POOL_USED_CHIPS, "used", "A"),
+                _target(POOL_CAPACITY_CHIPS, "capacity", "B"),
+            ],
+            "The bounded slice pool's inventory: chips allocated to pods vs "
+            "chips on ready nodes.  Used pinned at capacity is saturation — "
+            "the fair-share/preemption economy is arbitrating; capacity "
+            "stepping up mid-crunch is the cluster-autoscaler provisioning.",
+        ),
+        _ts_panel(
+            33,
+            "Capacity pool: pending pods by tenant",
+            12,
+            120,
+            [_target(f"sum by(tenant)({POOL_PENDING_PODS})", "{{tenant}}", "A")],
+            "Pods waiting for chips, per tenant.  A low-priority tenant "
+            "pending through a crunch is the economy working; a HIGH-priority "
+            "tenant pending here means preemption and provisioning both "
+            "failed it — check its HPA's Unschedulable condition and the "
+            "preemption panel.",
+        ),
+        _ts_panel(
+            34,
+            "Capacity pool: preemptions and pending time by tenant",
+            0,
+            128,
+            [
+                _target(
+                    f"sum by(tenant)(rate({POOL_PREEMPTIONS}[5m]))",
+                    "evictions/s {{tenant}}",
+                    "A",
+                ),
+                _target(
+                    f"sum by(tenant)(rate({POOL_PENDING_SECONDS}[5m]))",
+                    "pending s/s {{tenant}}",
+                    "B",
+                ),
+            ],
+            "The crunch's cost, per victim: eviction rate (each one a "
+            "pending→admitted→preempted→re-admitted round trip) and the rate "
+            "pending-seconds accumulate (1.0 = one pod continuously "
+            "starved).  A tenant burning pending time with NO evictions "
+            "anywhere is starving without recourse — its starvation budget "
+            "is the contract line.",
+        ),
+        _ts_panel(
+            35,
+            "Capacity pool: autoscaled nodes and provisioning failures",
+            12,
+            128,
+            [
+                _target(POOL_PROVISIONED_NODES, "autoscaled nodes", "A"),
+                _target(
+                    f"rate({POOL_PROVISION_FAILURES}[5m])",
+                    "provision failures/s",
+                    "B",
+                ),
+            ],
+            "The supply side: nodes the simulated cluster-autoscaler has "
+            "added (whole slice quanta, reaped when idle) and the rate its "
+            "provision attempts time out.  Failures with a flat node count "
+            "is the provision_fail fault signature — the autoscaler is in "
+            "exponential backoff while pods queue.",
         ),
     ]
     return {

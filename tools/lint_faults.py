@@ -10,7 +10,12 @@ silently rot in three ways this lint closes:
 - **undocumented**: the kind is missing from the module docstring's table,
   so the one place humans look for "what can I break?" lies by omission;
 - **untested**: no file under tests/ mentions the kind string, so its
-  injector (and clear) can regress without a single failure.
+  injector (and clear) can regress without a single failure;
+- **no injector test**: the kind has no row in the NATURAL_SPECS table of
+  tests/test_fault_injectors.py, so it is excluded from the auto-covering
+  inject/clear-twice/survive parametrization (a bare mention elsewhere in
+  tests/ would satisfy the previous check while the injector itself stays
+  unexercised).
 
 Usage:
     python tools/lint_faults.py
@@ -18,6 +23,7 @@ Usage:
 
 from __future__ import annotations
 
+import ast
 import sys
 from pathlib import Path
 
@@ -28,6 +34,31 @@ import k8s_gpu_hpa_tpu.chaos.faults as faults_mod  # noqa: E402
 from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS  # noqa: E402
 
 
+def _natural_spec_kinds(injector_test: Path) -> set[str]:
+    """The string keys of the NATURAL_SPECS dict, read via AST so the lint
+    sees the literal table (not a mutated import-time copy) and works even
+    when the test module cannot import."""
+    tree = ast.parse(injector_test.read_text())
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(
+            isinstance(t, ast.Name) and t.id == "NATURAL_SPECS" for t in targets
+        ):
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            return {
+                k.value
+                for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return set()
+
+
 def lint_fault_kinds(tests_dir: Path | None = None) -> list[str]:
     """Every registry violation, as human-readable strings."""
     tests_dir = tests_dir or (REPO / "tests")
@@ -36,6 +67,10 @@ def lint_fault_kinds(tests_dir: Path | None = None) -> list[str]:
     test_blobs = {
         p.name: p.read_text() for p in sorted(tests_dir.glob("test_*.py"))
     }
+    injector_test = tests_dir / "test_fault_injectors.py"
+    covered = (
+        _natural_spec_kinds(injector_test) if injector_test.exists() else set()
+    )
     for kind, injector in sorted(FAULT_KINDS.items()):
         if not callable(injector):
             errors.append(f"{kind}: registry entry is not callable ({injector!r})")
@@ -45,6 +80,11 @@ def lint_fault_kinds(tests_dir: Path | None = None) -> list[str]:
             )
         if not any(kind in blob for blob in test_blobs.values()):
             errors.append(f"{kind}: no file under tests/ references it")
+        if kind not in covered:
+            errors.append(
+                f"{kind}: no NATURAL_SPECS row in tests/test_fault_injectors.py "
+                "— excluded from the auto-covering injector parametrization"
+            )
     return errors
 
 

@@ -39,4 +39,9 @@ python tools/downsample_probe.py || exit 1
 # replay its WAL, and require reconvergence with zero spurious scale events
 # and lineage-complete traces — exit 0 IS the durability contract
 python -m k8s_gpu_hpa_tpu.simulate drill --components tsdb || exit 1
+# capacity-crunch smoke: three tenants spike into a bounded slice pool while
+# provisioning fails and a node drains — exit 0 IS the capacity contract
+# (pool conserved every tick, TTC p95 inside the priority-band gates, no
+# starvation past declared budgets, full convergence after the crunch)
+python -m k8s_gpu_hpa_tpu.simulate crunch || exit 1
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
