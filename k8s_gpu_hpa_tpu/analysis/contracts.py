@@ -1,0 +1,482 @@
+"""The metrics-contract pass: every consumed series must have a producer.
+
+A dangling metric name in a rule, dashboard panel, adapter seriesQuery,
+HPA manifest, or doctor probe fails *silently* at runtime — an empty
+instant vector, a panel showing "No data", an HPA stuck on
+``FailedGetPodsMetric``.  This pass makes it fail at lint time instead,
+the way ``promtool check`` keeps a real Prometheus honest:
+
+- **producers** come from the static symbol table (:mod:`.symbols`):
+  exporter families, pool metrics, self-metric histograms, recording-rule
+  outputs, SLO counters, the native exporter's TYPE lines;
+- **consumers** come from every surface that names a series: ``Expr``
+  constructions in package code, TSDB reads with literal names, the
+  shipped PrometheusRule parsed with :mod:`..metrics.promql`, Grafana
+  panel targets parsed in QUERY mode, adapter ``seriesQuery`` strings,
+  HPA manifest metric names, and metric-shaped literals in the curated
+  operator surfaces (doctor, simulate CLI, bench);
+- **checks**: dangling consumer, orphan producer, label-set mismatch
+  (only when the producer's label schema was statically visible), and
+  type misuse — ``rate()``/``increase()``/``BurnRate`` over a gauge,
+  ``histogram_quantile`` over a non-histogram family.
+
+Recorded series get their output label schema from the top-level
+``by(...)`` aggregation of their manifest expression, so an adapter
+``seriesQuery`` matching on a label the recording rule aggregates away is
+caught statically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+from k8s_gpu_hpa_tpu.analysis import AnalysisPass, Finding, register
+from k8s_gpu_hpa_tpu.analysis.symbols import (
+    Consumption,
+    METRIC_NAME_RE,
+    SymbolTable,
+    build_symbol_table,
+)
+from k8s_gpu_hpa_tpu.metrics import promql
+from k8s_gpu_hpa_tpu.metrics.promql import (
+    Increase,
+    PromQLError,
+    QHistogramQuantile,
+    QSelect,
+    Rate,
+)
+from k8s_gpu_hpa_tpu.metrics.rules import (
+    AggregateBy,
+    AvgOverTime,
+    BurnRate,
+    Expr,
+    HistogramQuantile,
+    MaxBy,
+    Select,
+)
+
+#: literal prefixes that mark a string in the curated surfaces (doctor,
+#: simulate, bench) as a metric reference even without full context.
+#: Narrow on purpose: ``slo_``-shaped strings are mostly report-row keys
+#: and rung names, and the real SLO counters resolve through the producer
+#: table anyway.
+CURATED_PREFIXES = ("tpu_", "kube_", "quantum_operator_", "fleet_")
+
+
+@dataclass
+class ContractConfig:
+    """Scan surfaces, as repo-relative paths — tests point these at golden
+    fixture trees; the default is the shipped tree."""
+
+    package_roots: tuple[str, ...] = ("k8s_gpu_hpa_tpu",)
+    native_sources: tuple[str, ...] = ("cpp/exporter/tpu_exporter.cc",)
+    rule_manifests: tuple[str, ...] = ("deploy/tpu-test-prometheusrule.yaml",)
+    dashboards: tuple[str, ...] = ("deploy/grafana-dashboard.yaml",)
+    adapter_values: tuple[str, ...] = ("deploy/prometheus-adapter-values.yaml",)
+    hpa_manifests: tuple[str, ...] = (
+        "deploy/tpu-test-hpa.yaml",
+        "deploy/tpu-test-hbm-hpa.yaml",
+        "deploy/tpu-test-external-hpa.yaml",
+        "deploy/tpu-test-multihost-hpa.yaml",
+        "deploy/tpu-serve-hpa.yaml",
+        "deploy/tpu-train-hpa.yaml",
+    )
+    curated: tuple[str, ...] = (
+        "k8s_gpu_hpa_tpu/doctor.py",
+        "k8s_gpu_hpa_tpu/simulate.py",
+        "bench.py",
+    )
+
+
+# ---------------------------------------------------------------------------
+# expression walking
+# ---------------------------------------------------------------------------
+
+
+def iter_expr_consumptions(
+    expr: Expr, file: str, line: int, surface: str, usage: str = "plain"
+):
+    """Yield a :class:`Consumption` for every series an Expr reads, with
+    the usage context (rate/burn/quantile) type checks need."""
+    if isinstance(expr, (Rate, Increase)):
+        yield from iter_expr_consumptions(expr.child, file, line, surface, "rate")
+        return
+    if isinstance(expr, BurnRate):
+        yield Consumption(
+            expr.good_name,
+            file,
+            line,
+            surface,
+            frozenset(expr.good_matchers),
+            "burn",
+        )
+        yield Consumption(
+            expr.total_name,
+            file,
+            line,
+            surface,
+            frozenset(expr.total_matchers),
+            "burn",
+        )
+        return
+    if isinstance(expr, HistogramQuantile):
+        yield Consumption(
+            expr.name + "_bucket",
+            file,
+            line,
+            surface,
+            frozenset(expr.matchers),
+            "quantile",
+        )
+        return
+    if isinstance(expr, QHistogramQuantile):
+        yield from iter_expr_consumptions(
+            expr.child, file, line, surface, "quantile-child"
+        )
+        return
+    if isinstance(expr, Select):
+        yield Consumption(
+            expr.name, file, line, surface, frozenset(expr.matchers), usage
+        )
+        return
+    if isinstance(expr, QSelect):
+        yield Consumption(
+            expr.name,
+            file,
+            line,
+            surface,
+            frozenset(k for k, _, _ in expr.matchers),
+            usage,
+        )
+        return
+    if isinstance(expr, AvgOverTime):
+        yield Consumption(
+            expr.name, file, line, surface, frozenset(expr.matchers), usage
+        )
+        return
+    # generic: recurse into every Expr-valued dataclass field
+    if dataclasses.is_dataclass(expr):
+        for f in dataclasses.fields(expr):
+            v = getattr(expr, f.name)
+            if isinstance(v, Expr):
+                yield from iter_expr_consumptions(v, file, line, surface, usage)
+            elif isinstance(v, (tuple, list)):
+                for item in v:
+                    if isinstance(item, Expr):
+                        yield from iter_expr_consumptions(
+                            item, file, line, surface, usage
+                        )
+    else:  # pragma: no cover - future node shapes
+        for name in expr.input_names():
+            yield Consumption(name, file, line, surface, frozenset(), usage)
+
+
+def _record_output_labels(expr: Expr) -> set[str] | None:
+    """The label schema a recording rule's output series carries, when it
+    is statically clear: a top-level ``by(...)`` aggregation keeps exactly
+    its keys.  Anything else (joins, scalar aggregates) returns None —
+    unknown, exempt from label checks."""
+    if isinstance(expr, MaxBy):
+        return set(expr.keys)
+    if isinstance(expr, AggregateBy):
+        return set(expr.keys)
+    return None
+
+
+def _find_line(text_lines: list[str], needle: str, start: int = 0) -> int:
+    for i in range(start, len(text_lines)):
+        if needle in text_lines[i]:
+            return i + 1
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# manifest surfaces
+# ---------------------------------------------------------------------------
+
+
+def scan_rule_manifest(
+    root: Path, rel: str, table: SymbolTable
+) -> tuple[list[Consumption], list[str]]:
+    """PrometheusRule: ``expr:`` strings are consumers (parsed to ASTs),
+    ``record:`` names are producers (type "recorded", labels from the
+    top-level by-aggregation).  Unparseable exprs are skipped — the
+    promql-parity pass owns reporting those."""
+    path = root / rel
+    consumptions: list[Consumption] = []
+    errors: list[str] = []
+    if not path.exists():
+        return consumptions, errors
+    text_lines = path.read_text().splitlines()
+    doc = yaml.safe_load(path.read_text())
+    cursor = 0
+    for group in doc.get("spec", {}).get("groups", []):
+        for entry in group.get("rules", []):
+            expr_text = entry.get("expr", "")
+            needle = expr_text.splitlines()[0][:60] if expr_text else ""
+            line = _find_line(text_lines, needle, cursor) if needle else 1
+            cursor = max(cursor, line - 1)
+            try:
+                ast_expr = promql.parse(expr_text)
+            except PromQLError:
+                continue
+            consumptions.extend(
+                iter_expr_consumptions(ast_expr, rel, line, "manifest")
+            )
+            if "record" in entry:
+                from k8s_gpu_hpa_tpu.analysis.symbols import Site
+
+                table.add(
+                    entry["record"],
+                    "recorded",
+                    Site(rel, line, "manifest-record"),
+                    _record_output_labels(ast_expr),
+                )
+    return consumptions, errors
+
+
+def scan_dashboard(root: Path, rel: str) -> list[Consumption]:
+    """Grafana ConfigMap: every panel target expr, parsed in QUERY mode.
+    Parse failures are the dashboard-parity pass's findings, not ours."""
+    path = root / rel
+    out: list[Consumption] = []
+    if not path.exists():
+        return out
+    text_lines = path.read_text().splitlines()
+    doc = yaml.safe_load(path.read_text())
+    for _, blob in sorted(doc.get("data", {}).items()):
+        try:
+            dash = json.loads(blob)
+        except (TypeError, json.JSONDecodeError):
+            continue
+        for panel in dash.get("panels", []):
+            for target in panel.get("targets", []):
+                expr_text = target.get("expr", "")
+                if not expr_text:
+                    continue
+                try:
+                    ast_expr = promql.parse_query(expr_text)
+                except PromQLError:
+                    continue
+                # the ConfigMap embeds JSON with escaped quotes; locate by
+                # a matcher-free fragment of the expression
+                needle = expr_text.split("{")[0].split("(")[-1][:40]
+                line = _find_line(text_lines, needle) if needle else 1
+                out.extend(
+                    iter_expr_consumptions(ast_expr, rel, line, "dashboard")
+                )
+    return out
+
+
+_SERIES_QUERY_RE = re.compile(r"seriesQuery:\s*'([A-Za-z_:][A-Za-z0-9_:]*)\{([^}]*)\}")
+_MATCHER_KEY_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)\s*(?:!=|=~|!~|=)")
+
+
+def scan_adapter_values(root: Path, rel: str) -> list[Consumption]:
+    """prometheus-adapter values: the series each seriesQuery discovers,
+    with its matcher label keys."""
+    path = root / rel
+    out: list[Consumption] = []
+    if not path.exists():
+        return out
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        m = _SERIES_QUERY_RE.search(line)
+        if m is None:
+            continue
+        keys = frozenset(_MATCHER_KEY_RE.findall(m.group(2)))
+        out.append(Consumption(m.group(1), rel, lineno, "adapter", keys))
+    return out
+
+
+_HPA_METRIC_RE = re.compile(r"^\s+name:\s+([a-z][a-z0-9_:]*_[a-z0-9_:]*)\s*$")
+
+
+def scan_hpa_manifest(root: Path, rel: str) -> list[Consumption]:
+    """HPA specs: Pods/External metric names (underscore-shaped ``name:``
+    values; resource metrics like ``cpu`` don't match the grammar)."""
+    path = root / rel
+    out: list[Consumption] = []
+    if not path.exists():
+        return out
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        m = _HPA_METRIC_RE.match(line)
+        if m is not None:
+            out.append(Consumption(m.group(1), rel, lineno, "hpa"))
+    return out
+
+
+def scan_curated_literals(root: Path, rel: str, table: SymbolTable) -> list[Consumption]:
+    """Doctor/CLI/bench surfaces: any string literal that either names a
+    known producer (credits consumption, so the orphan check sees doctor
+    probes) or carries an unmistakable metric prefix (catches danglers)."""
+    import ast as pyast
+
+    path = root / rel
+    out: list[Consumption] = []
+    if not path.exists():
+        return out
+    try:
+        tree = pyast.parse(path.read_text())
+    except SyntaxError:
+        return out
+    for node in pyast.walk(tree):
+        if not (isinstance(node, pyast.Constant) and isinstance(node.value, str)):
+            continue
+        value = node.value
+        if not METRIC_NAME_RE.match(value):
+            continue
+        if table.resolve_series(value) is not None or value.startswith(
+            CURATED_PREFIXES
+        ):
+            out.append(
+                Consumption(value, rel, node.lineno, "literal")
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+
+class MetricsContractPass(AnalysisPass):
+    name = "metrics-contract"
+    description = (
+        "every consumed series resolves to a statically discovered "
+        "producer; no orphan families, label or type misuse"
+    )
+
+    def __init__(self, config: ContractConfig | None = None):
+        self.config = config or ContractConfig()
+
+    def run(self, root: Path) -> list[Finding]:
+        cfg = self.config
+        table, consumptions = build_symbol_table(
+            root, cfg.package_roots, cfg.native_sources
+        )
+        for rel in cfg.rule_manifests:
+            cons, _ = scan_rule_manifest(root, rel, table)
+            consumptions.extend(cons)
+        for rel in cfg.dashboards:
+            consumptions.extend(scan_dashboard(root, rel))
+        for rel in cfg.adapter_values:
+            consumptions.extend(scan_adapter_values(root, rel))
+        for rel in cfg.hpa_manifests:
+            consumptions.extend(scan_hpa_manifest(root, rel))
+        for rel in cfg.curated:
+            consumptions.extend(scan_curated_literals(root, rel, table))
+        return self.check(table, consumptions)
+
+    def check(
+        self, table: SymbolTable, consumptions: list[Consumption]
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        consumed_families: set[str] = set()
+        seen_dangling: set[tuple[str, str, int]] = set()
+        for c in consumptions:
+            fam = table.resolve_series(c.name)
+            if fam is None:
+                key = (c.name, c.file, c.line)
+                if key not in seen_dangling:
+                    seen_dangling.add(key)
+                    findings.append(
+                        self.finding(
+                            "dangling-consumer",
+                            c.file,
+                            c.line,
+                            c.name,
+                            f"{c.surface} reads series {c.name!r} but no "
+                            "producer declares it — the read will be "
+                            "silently empty at runtime",
+                        )
+                    )
+                continue
+            consumed_families.add(fam.name)
+            findings.extend(self._check_types(c, fam))
+            findings.extend(self._check_labels(c, fam))
+        for name, fam in sorted(table.families.items()):
+            if name in consumed_families:
+                continue
+            site = fam.sites[0]
+            findings.append(
+                self.finding(
+                    "orphan-producer",
+                    site.file,
+                    site.line,
+                    name,
+                    f"family {name!r} ({fam.type}) is produced but no rule, "
+                    "dashboard, probe, or manifest consumes it — dead "
+                    "telemetry or a missing panel",
+                )
+            )
+        return findings
+
+    def _check_types(self, c: Consumption, fam) -> list[Finding]:
+        out: list[Finding] = []
+        histogram_series = fam.type == "histogram" and c.name != fam.name
+        if c.usage == "rate" and fam.type == "gauge":
+            out.append(
+                self.finding(
+                    "type-misuse",
+                    c.file,
+                    c.line,
+                    c.name,
+                    f"rate()/increase() over {c.name!r}, which is declared a "
+                    "gauge — counter semantics over last-value data",
+                )
+            )
+        if c.usage == "burn" and fam.type == "gauge" and not histogram_series:
+            out.append(
+                self.finding(
+                    "type-misuse",
+                    c.file,
+                    c.line,
+                    c.name,
+                    f"BurnRate counts increase() over {c.name!r}, which is "
+                    "declared a gauge — burn math needs cumulative counters",
+                )
+            )
+        if c.usage in ("quantile", "quantile-child"):
+            if c.name.endswith("_bucket") and fam.type != "histogram":
+                out.append(
+                    self.finding(
+                        "type-misuse",
+                        c.file,
+                        c.line,
+                        c.name,
+                        f"histogram_quantile over {c.name!r} but "
+                        f"{fam.name!r} is declared {fam.type}, not a "
+                        "histogram",
+                    )
+                )
+        return out
+
+    def _check_labels(self, c: Consumption, fam) -> list[Finding]:
+        if not c.matcher_keys or fam.labels is None:
+            return []
+        schema = set(fam.labels)
+        if fam.type == "histogram":
+            schema.add("le")
+        missing = sorted(k for k in c.matcher_keys if k not in schema)
+        if not missing:
+            return []
+        return [
+            self.finding(
+                "label-mismatch",
+                c.file,
+                c.line,
+                c.name,
+                f"matcher label(s) {', '.join(missing)} on {c.name!r} are "
+                f"not in the producer's schema {{{', '.join(sorted(schema))}}}"
+                " — the selector can never match",
+            )
+        ]
+
+
+register(MetricsContractPass())

@@ -1,0 +1,168 @@
+"""Thin adapters: the five pre-existing lints as registered passes.
+
+Each adapter calls the original tool's public entry point unchanged —
+behavior preserved, output format unified — so ``tools/analyze.py --all``
+is the single tier-1 gate where ``tools/tier1.sh`` used to chain five
+script invocations.  The originals stay runnable standalone; these
+adapters import them by file path (``tools/`` is not a package).
+
+- ``fault-registry``    -> tools/lint_faults.py
+- ``promql-parity``     -> tools/lint_promql_parity.py (rule manifest)
+- ``dashboard-parity``  -> tools/lint_promql_parity.py (Grafana panels)
+- ``trace-schema``      -> tools/lint_trace_schema.py --selfcheck
+- ``rollup-probe``      -> tools/downsample_probe.py
+"""
+
+from __future__ import annotations
+
+import contextlib
+import importlib.util
+import io
+import sys
+from pathlib import Path
+
+from k8s_gpu_hpa_tpu.analysis import AnalysisPass, Finding, register
+
+_MODULES: dict[str, object] = {}
+
+
+def _load_tool(root: Path, name: str):
+    """Import tools/<name>.py by path (cached per name)."""
+    if name in _MODULES:
+        return _MODULES[name]
+    path = root / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(f"_analyze_{name}", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    _MODULES[name] = module
+    return module
+
+
+class FaultRegistryPass(AnalysisPass):
+    name = "fault-registry"
+    description = (
+        "every chaos fault kind has an injector, a docstring row, and "
+        "auto-covering test parametrization (tools/lint_faults.py)"
+    )
+
+    def run(self, root: Path) -> list[Finding]:
+        tool = _load_tool(root, "lint_faults")
+        return [
+            self.finding(
+                "fault-kind",
+                "k8s_gpu_hpa_tpu/chaos/faults.py",
+                1,
+                err.split(":", 1)[0],
+                err,
+            )
+            for err in tool.lint_fault_kinds(root / "tests")
+        ]
+
+
+class PromQLParityPass(AnalysisPass):
+    name = "promql-parity"
+    description = (
+        "every shipped PrometheusRule expr parses back to the exact AST "
+        "the closed loop evaluates (tools/lint_promql_parity.py)"
+    )
+
+    def run(self, root: Path) -> list[Finding]:
+        tool = _load_tool(root, "lint_promql_parity")
+        rel = "deploy/tpu-test-prometheusrule.yaml"
+        return [
+            self.finding("parity", rel, 1, err.split(":", 1)[0], err)
+            for err in tool.lint_parity(root / rel)
+        ]
+
+
+class DashboardParityPass(AnalysisPass):
+    name = "dashboard-parity"
+    description = (
+        "every Grafana panel target parses canonically in the PromQL "
+        "QUERY subset (tools/lint_promql_parity.py)"
+    )
+
+    def run(self, root: Path) -> list[Finding]:
+        tool = _load_tool(root, "lint_promql_parity")
+        rel = "deploy/grafana-dashboard.yaml"
+        errors, _count = tool.lint_dashboard(root / rel)
+        return [
+            self.finding("parity", rel, 1, err.split(":", 1)[0], err)
+            for err in errors
+        ]
+
+
+class TraceSchemaPass(AnalysisPass):
+    name = "trace-schema"
+    description = (
+        "live span emitters match obs/schema.py and self-metric exemplars "
+        "resolve into the trace export (tools/lint_trace_schema.py "
+        "--selfcheck: runs a short traced sim in-process)"
+    )
+
+    def run(self, root: Path) -> list[Finding]:
+        tool = _load_tool(root, "lint_trace_schema")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = tool._selfcheck()
+        if rc == 0:
+            return []
+        lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+        return [
+            self.finding(
+                "trace-schema", "k8s_gpu_hpa_tpu/obs/trace.py", 1, "selfcheck", ln
+            )
+            for ln in lines
+        ] or [
+            self.finding(
+                "trace-schema",
+                "k8s_gpu_hpa_tpu/obs/trace.py",
+                1,
+                "selfcheck",
+                f"selfcheck failed with rc={rc} and no output",
+            )
+        ]
+
+
+class RollupProbePass(AnalysisPass):
+    name = "rollup-probe"
+    description = (
+        "the 5m/1h rollup tiers hold sealed buckets and bit-agree with the "
+        "raw bucketed twin (tools/downsample_probe.py: ages a deterministic "
+        "DB through the compactor in-process)"
+    )
+
+    def run(self, root: Path) -> list[Finding]:
+        tool = _load_tool(root, "downsample_probe")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = tool.main([])
+        if rc == 0:
+            return []
+        lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+        return [
+            self.finding(
+                "rollup",
+                "k8s_gpu_hpa_tpu/metrics/downsample.py",
+                1,
+                "probe",
+                ln,
+            )
+            for ln in lines
+        ] or [
+            self.finding(
+                "rollup",
+                "k8s_gpu_hpa_tpu/metrics/downsample.py",
+                1,
+                "probe",
+                f"probe failed with rc={rc} and no output",
+            )
+        ]
+
+
+register(FaultRegistryPass())
+register(PromQLParityPass())
+register(DashboardParityPass())
+register(TraceSchemaPass())
+register(RollupProbePass())

@@ -478,11 +478,27 @@ class SimCluster:
         cache (ScrapeTarget.trace_origin provider)."""
         return self.exporters[node_name].last_span_id
 
+    #: the one-hot phase vocabulary kube-state-metrics exports per pod; the
+    #: sim's extra lifecycle states map onto it the way the kubelet reports
+    #: them upstream (CrashLoopBackOff pods are Pending at the API level,
+    #: Terminating pods still report Running until deletion completes)
+    KSM_PHASES = ("Pending", "Running", "Succeeded", "Failed", "Unknown")
+    _KSM_PHASE_MAP = {"CrashLoopBackOff": "Pending", "Terminating": "Running"}
+
     def kube_state_metrics_families(self) -> list[MetricFamily]:
-        """``kube_pod_labels`` for every pod (kube-state-metrics exports Pending
-        pods too; the rule's inner join plus the absent device metric is what
-        keeps them out of the average — SURVEY.md §3.2)."""
+        """``kube_pod_labels`` and ``kube_pod_status_phase`` for every pod
+        (kube-state-metrics exports Pending pods too; the rule's inner join
+        plus the absent device metric is what keeps them out of the average
+        — SURVEY.md §3.2).  The phase family is the one-hot vector the
+        flat-zero alerts join on (``kube_pod_status_phase{phase="Running"}``,
+        metrics/rules.py) — without it the present-but-dead guard could
+        never see a Running pod in-sim."""
         fam = MetricFamily("kube_pod_labels", "gauge", "Kubernetes pod labels")
+        phase_fam = MetricFamily(
+            "kube_pod_status_phase",
+            "gauge",
+            "Kubernetes pod status phase (one-hot)",
+        )
         for pod in self.pods.values():
             fam.add(
                 1.0,
@@ -490,7 +506,15 @@ class SimCluster:
                 pod=pod.name,
                 label_app=pod.labels.get("app", ""),
             )
-        return [fam]
+            reported = self._KSM_PHASE_MAP.get(pod.phase, pod.phase)
+            for phase in self.KSM_PHASES:
+                phase_fam.add(
+                    1.0 if phase == reported else 0.0,
+                    namespace=pod.namespace,
+                    pod=pod.name,
+                    phase=phase,
+                )
+        return [fam, phase_fam]
 
     def kube_state_metrics_text(self) -> str:
         """Text-exposition rendering of ``kube_state_metrics_families`` (the

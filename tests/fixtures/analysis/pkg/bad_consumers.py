@@ -1,0 +1,14 @@
+"""Golden fixture: seeded consumer-side violations for the
+metrics-contract pass.  Never imported — the analyzer reads the AST.
+
+Seeded violations (each must fire exactly once):
+- ``fixture_missing_metric``: read but produced nowhere
+  -> dangling-consumer.
+- ``fixture_requests_total{pod=...}``: the producer's schema is {node}
+  -> label-mismatch.
+"""
+
+from k8s_gpu_hpa_tpu.metrics.rules import Select
+
+MISSING = Select("fixture_missing_metric", {})
+MISMATCHED = Select("fixture_requests_total", {"pod": "x"})

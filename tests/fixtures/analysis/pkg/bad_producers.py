@@ -1,0 +1,24 @@
+"""Golden fixture: seeded producer-side violations for the
+metrics-contract pass.  Never imported — the analyzer reads the AST.
+
+Seeded violations (each must fire exactly once):
+- ``fixture_orphan_total``: produced, consumed nowhere -> orphan-producer.
+
+Supporting cast (produced here, consumed with seeded mistakes elsewhere):
+- ``fixture_requests_total``: counter with label schema {node} — the
+  consumer fixture selects on ``pod`` -> label-mismatch.
+- ``fixture_temp_celsius``: gauge — the dashboard fixture rates it
+  -> type-misuse.
+"""
+
+from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
+
+
+def families():
+    orphan = MetricFamily("fixture_orphan_total", "counter", "never read")
+    orphan.add(1.0)
+    requests = MetricFamily("fixture_requests_total", "counter", "per node")
+    requests.add(1.0, node="a")
+    temp = MetricFamily("fixture_temp_celsius", "gauge", "a last-value gauge")
+    temp.add(21.5)
+    return [orphan, requests, temp]

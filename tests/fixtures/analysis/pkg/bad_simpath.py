@@ -1,0 +1,18 @@
+"""Golden fixture: seeded sim-purity violation.  Never imported.
+
+Seeded violation (must fire exactly once):
+- ``time.time()`` in sim scope -> wall-clock.
+
+``time.perf_counter()`` rides along to pin the deliberate exception:
+duration measurement is allowed, timestamps are not.
+"""
+
+import time
+
+
+def now() -> float:
+    return time.time()
+
+
+def duration_probe() -> float:
+    return time.perf_counter()

@@ -1,0 +1,187 @@
+"""The static-analysis passes against golden fixtures and the real tree.
+
+Each seeded violation in tests/fixtures/analysis/ must fire exactly once,
+with the right category and file:line — a lint that double-reports or
+drifts off the offending line erodes trust as fast as one that misses.
+The shipped tree itself must scan clean (the tier-1 gate), and the
+allowlist must be reviewed in both directions: entries suppress findings,
+and entries that suppress nothing are themselves findings."""
+
+from pathlib import Path
+
+from k8s_gpu_hpa_tpu import analysis
+from k8s_gpu_hpa_tpu.analysis.allowlist import ALLOWLIST, AllowEntry
+from k8s_gpu_hpa_tpu.analysis.contracts import ContractConfig, MetricsContractPass
+from k8s_gpu_hpa_tpu.analysis.purity import PurityConfig, SimPurityPass
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+FIXTURE_CONTRACTS = ContractConfig(
+    package_roots=("pkg",),
+    native_sources=(),
+    rule_manifests=(),
+    dashboards=("bad_dashboard.yaml",),
+    adapter_values=(),
+    hpa_manifests=(),
+    curated=(),
+)
+
+
+def _line_of(rel: str, needle: str) -> int:
+    for lineno, line in enumerate(
+        (FIXTURES / rel).read_text().splitlines(), 1
+    ):
+        if needle in line:
+            return lineno
+    raise AssertionError(f"{rel} has no line containing {needle!r}")
+
+
+def _only(findings, category: str):
+    hits = [f for f in findings if f.category == category]
+    assert len(hits) == 1, (
+        f"expected exactly one {category} finding, got "
+        f"{[f.render() for f in hits]}"
+    )
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures: each seeded violation fires exactly once, at its line
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_contract_findings_fire_exactly_once():
+    findings = MetricsContractPass(FIXTURE_CONTRACTS).run(FIXTURES)
+    assert len(findings) == 4, [f.render() for f in findings]
+
+    dangling = _only(findings, "dangling-consumer")
+    assert dangling.subject == "fixture_missing_metric"
+    assert dangling.file == "pkg/bad_consumers.py"
+    assert dangling.line == _line_of(
+        "pkg/bad_consumers.py", '"fixture_missing_metric"'
+    )
+
+    orphan = _only(findings, "orphan-producer")
+    assert orphan.subject == "fixture_orphan_total"
+    assert orphan.file == "pkg/bad_producers.py"
+    assert orphan.line == _line_of(
+        "pkg/bad_producers.py", '"fixture_orphan_total"'
+    )
+
+    mismatch = _only(findings, "label-mismatch")
+    assert mismatch.subject == "fixture_requests_total"
+    assert mismatch.file == "pkg/bad_consumers.py"
+    assert mismatch.line == _line_of(
+        "pkg/bad_consumers.py", '"fixture_requests_total"'
+    )
+    assert "pod" in mismatch.message and "node" in mismatch.message
+
+    misuse = _only(findings, "type-misuse")
+    assert misuse.subject == "fixture_temp_celsius"
+    assert misuse.file == "bad_dashboard.yaml"
+    assert misuse.line == _line_of(
+        "bad_dashboard.yaml", "rate(fixture_temp_celsius[5m])"
+    )
+
+
+def test_fixture_purity_finding_fires_exactly_once():
+    findings = SimPurityPass(
+        PurityConfig(scope=("pkg/bad_simpath.py",))
+    ).run(FIXTURES)
+    assert len(findings) == 1, [f.render() for f in findings]
+    (wall,) = findings
+    assert wall.category == "wall-clock"
+    assert wall.subject == "pkg/bad_simpath.py:time.time"
+    assert wall.line == _line_of("pkg/bad_simpath.py", "return time.time()")
+    # the deliberate exception: perf_counter measures durations, not
+    # timestamps, and must never be flagged
+    assert not any("perf_counter" in f.subject for f in findings)
+
+
+def test_fixture_dashboard_read_credits_consumption():
+    """The gauge the dashboard rates is consumed (wrongly, but consumed) —
+    it must show up as type-misuse, never double-counted as an orphan."""
+    findings = MetricsContractPass(FIXTURE_CONTRACTS).run(FIXTURES)
+    orphans = {f.subject for f in findings if f.category == "orphan-producer"}
+    assert "fixture_temp_celsius" not in orphans
+    assert "fixture_requests_total" not in orphans
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree: clean under the reviewed allowlist
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_tree_is_clean():
+    report = analysis.run_passes(["metrics-contract", "sim-purity"])
+    assert report.ok, [f.render() for f in report.findings]
+    # the exemptions are real: some findings were suppressed, each with a
+    # reviewed one-line justification
+    assert report.allowed
+    assert all(why.strip() for _, why in report.allowed)
+
+
+def test_every_allowlist_entry_names_a_registered_pass():
+    known = {p.name for p in analysis.registered_passes()}
+    for entry in ALLOWLIST:
+        assert entry.pass_name in known, entry
+
+
+def test_stale_allowlist_entry_is_a_finding():
+    stale = AllowEntry(
+        "sim-purity",
+        "wall-clock",
+        "pkg/never_existed.py:time.time",
+        "stale on purpose",
+    )
+    report = analysis.run_passes(
+        ["sim-purity"], root=FIXTURES, allowlist=(stale,)
+    )
+    assert not report.ok
+    (finding,) = report.findings
+    assert finding.category == "stale-allowlist"
+    assert finding.subject == "pkg/never_existed.py:time.time"
+
+
+def test_fixture_tree_fails_the_gate_with_every_violation_class():
+    """run_passes is exactly what tools/analyze.py exits on: pointing the
+    two new passes at the fixture tree must fail the gate (ok=False ->
+    exit 1) with all five seeded violation classes active."""
+    analysis.register(MetricsContractPass(FIXTURE_CONTRACTS))
+    analysis.register(SimPurityPass(PurityConfig(scope=("pkg/bad_simpath.py",))))
+    try:
+        report = analysis.run_passes(
+            ["metrics-contract", "sim-purity"], root=FIXTURES, allowlist=()
+        )
+    finally:
+        analysis.register(MetricsContractPass())
+        analysis.register(SimPurityPass())
+    assert not report.ok
+    assert {f.category for f in report.findings} == {
+        "dangling-consumer",
+        "orphan-producer",
+        "label-mismatch",
+        "type-misuse",
+        "wall-clock",
+    }
+
+
+def test_matched_allowlist_entry_suppresses_and_is_not_stale():
+    entry = AllowEntry(
+        "sim-purity",
+        "wall-clock",
+        "pkg/bad_simpath.py:time.time",
+        "seeded fixture violation, excused for this test",
+    )
+    fixture_pass = SimPurityPass(PurityConfig(scope=("pkg/bad_simpath.py",)))
+    analysis.register(fixture_pass)
+    try:
+        report = analysis.run_passes(
+            ["sim-purity"], root=FIXTURES, allowlist=(entry,)
+        )
+    finally:
+        analysis.register(SimPurityPass())  # restore the shipped config
+    assert report.ok, [f.render() for f in report.findings]
+    ((allowed, why),) = report.allowed
+    assert allowed.subject == "pkg/bad_simpath.py:time.time"
+    assert why == entry.justification

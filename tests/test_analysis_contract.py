@@ -1,0 +1,104 @@
+"""The analyzer's operator contract: registry, report schema, tier-1 wiring.
+
+Mirrors the auto-coverage discipline of tools/lint_faults.py: the CLI's
+``--list`` must enumerate exactly the registered passes, the ``--json``
+report must keep the schema other tooling consumes, and tier-1 must run
+the one unified gate (``tools/analyze.py --all``) rather than the five
+serial lint invocations it replaced — so adding a pass without wiring it
+into the gate is structurally impossible."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from k8s_gpu_hpa_tpu import analysis
+
+REPO = Path(__file__).parent.parent
+ANALYZE = REPO / "tools" / "analyze.py"
+
+FINDING_KEYS = {"pass", "category", "file", "line", "subject", "message"}
+
+#: the passes this PR ships; the registry may grow, never shrink
+EXPECTED_PASSES = {
+    "metrics-contract",
+    "sim-purity",
+    "fault-registry",
+    "promql-parity",
+    "dashboard-parity",
+    "trace-schema",
+    "rollup-probe",
+}
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(ANALYZE), *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=540,
+    )
+
+
+def test_list_json_matches_registry():
+    proc = _run("--list", "--json")
+    assert proc.returncode == 0, proc.stderr
+    listed = json.loads(proc.stdout)["passes"]
+    assert {p["name"] for p in listed} == {
+        p.name for p in analysis.registered_passes()
+    }
+    assert EXPECTED_PASSES <= {p["name"] for p in listed}
+    for p in listed:
+        assert p["description"].strip()
+
+
+def test_json_report_schema_on_the_new_passes():
+    proc = _run("--pass", "metrics-contract", "--pass", "sim-purity", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert {p["name"] for p in report["passes"]} == {
+        "metrics-contract",
+        "sim-purity",
+    }
+    for p in report["passes"]:
+        assert p["findings"] == 0
+    assert report["findings"] == []
+    # the reviewed exemptions surface in the report, each carrying its
+    # finding provenance plus a nonempty justification
+    assert report["allowed"]
+    for entry in report["allowed"]:
+        assert FINDING_KEYS <= set(entry)
+        assert entry["justification"].strip()
+        assert isinstance(entry["line"], int)
+
+
+def test_unknown_pass_is_a_usage_error():
+    proc = _run("--pass", "no-such-pass")
+    assert proc.returncode == 2
+    assert "no-such-pass" in proc.stderr
+
+
+def test_tier1_runs_the_unified_gate():
+    tier1 = (REPO / "tools" / "tier1.sh").read_text()
+    assert "tools/analyze.py --all" in tier1
+    # the five serial lint invocations the gate replaced must stay gone;
+    # the scripts remain runnable standalone, tier-1 just reaches them
+    # through the pass registry
+    for retired in (
+        "tools/lint_trace_schema.py",
+        "tools/lint_faults.py",
+        "tools/lint_promql_parity.py",
+        "tools/downsample_probe.py",
+    ):
+        assert retired not in tier1, f"{retired} bypasses the unified gate"
+
+
+def test_registry_rejects_unnamed_passes():
+    try:
+        analysis.register(analysis.AnalysisPass())
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("nameless pass must not register")

@@ -180,3 +180,47 @@ def test_exporter_outage_holds_replicas():
 
 def _raise_down():
     raise ConnectionError("exporter down")
+
+
+def test_ksm_exports_one_hot_pod_phase_and_it_reaches_the_tsdb():
+    """Pins the KSM surrogate's phase export: the flat-zero alerts join on
+    kube_pod_status_phase{phase="Running"} (metrics/rules.py), so the
+    family must be a one-hot vector over the full KSM vocabulary, fold the
+    sim-only phases onto real ones, and actually flow through the
+    kube-state-metrics scrape into the pipeline's TSDB."""
+    pipeline = make_pipeline(lambda t: 20.0)
+    pipeline.run_for(60.0)
+    cluster = pipeline.cluster
+    (pod,) = cluster.running_pods("tpu-test")
+
+    fams = {f.name: f for f in cluster.kube_state_metrics_families()}
+    phase_fam = fams["kube_pod_status_phase"]
+    assert phase_fam.type == "gauge"
+    values = {
+        dict(s.labels)["phase"]: s.value
+        for s in phase_fam.samples
+        if dict(s.labels)["pod"] == pod.name
+    }
+    assert set(values) == set(SimCluster.KSM_PHASES)
+    assert values["Running"] == 1.0
+    assert sum(values.values()) == 1.0  # one-hot: exactly one phase set
+
+    # sim-only phases fold onto the vocabulary kube-state-metrics exports
+    pod.phase = "CrashLoopBackOff"
+    folded = {
+        dict(s.labels)["phase"]: s.value
+        for f in cluster.kube_state_metrics_families()
+        if f.name == "kube_pod_status_phase"
+        for s in f.samples
+        if dict(s.labels)["pod"] == pod.name
+    }
+    assert folded["Pending"] == 1.0 and folded["Running"] == 0.0
+    pod.phase = "Running"
+
+    # and the scrape target delivers the series into the pipeline's TSDB
+    assert (
+        pipeline.db.latest(
+            "kube_pod_status_phase", {"pod": pod.name, "phase": "Running"}
+        )
+        == 1.0
+    )

@@ -202,6 +202,8 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
             # exporter self-metrics (cpp/exporter)
             "tpu_metrics_exporter_up",
             "tpu_metrics_exporter_sample_age_seconds",
+            "tpu_metrics_exporter_scrapes_total",
+            "tpu_metrics_exporter_collect_sweeps_total",
             # workload self-report surfaced by the exporter (the External
             # rung's demand signal, exporter/native.py queue gauges)
             "tpu_test_queue_depth",

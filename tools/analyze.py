@@ -1,0 +1,91 @@
+"""Run the static-analysis passes: one gate, one finding format.
+
+``--all`` is the tier-1 invocation — every registered pass, nonzero exit
+on any unsuppressed finding.  ``--pass <name>`` (repeatable) selects
+passes for local debugging; ``--list`` enumerates the registry without
+running anything; ``--json`` emits the machine-readable report
+``tests/test_analysis_contract.py`` pins.
+
+Usage:
+    python tools/analyze.py --all [--json]
+    python tools/analyze.py --pass metrics-contract [--pass sim-purity] [--json]
+    python tools/analyze.py --list [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from k8s_gpu_hpa_tpu import analysis  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    want_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if argv == ["--list"]:
+        passes = analysis.registered_passes()
+        if want_json:
+            print(
+                json.dumps(
+                    {
+                        "passes": [
+                            {"name": p.name, "description": p.description}
+                            for p in passes
+                        ]
+                    },
+                    indent=2,
+                )
+            )
+        else:
+            for p in passes:
+                print(f"{p.name}: {p.description}")
+        return 0
+    names: list[str] | None = None
+    if argv == ["--all"]:
+        names = None
+    elif argv and all(
+        argv[i] == "--pass" if i % 2 == 0 else True for i in range(len(argv))
+    ) and len(argv) % 2 == 0:
+        names = argv[1::2]
+        known = {p.name for p in analysis.registered_passes()}
+        unknown = [n for n in names if n not in known]
+        if unknown:
+            print(
+                f"analyze: unknown pass(es): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        print(__doc__.split("Usage:")[1].strip(), file=sys.stderr)
+        return 2
+    report = analysis.run_passes(names)
+    if want_json:
+        print(json.dumps(report.as_dict(), indent=2))
+        return 0 if report.ok else 1
+    for f in report.findings:
+        print(f"analyze: {f.render()}")
+    ran = report.passes
+    if report.ok:
+        n_allowed = len(report.allowed)
+        print(
+            f"analyze ok: {len(ran)} pass(es) clean "
+            f"({', '.join(ran)}); {n_allowed} reviewed exemption(s) applied"
+        )
+        return 0
+    print(
+        f"analyze: {len(report.findings)} finding(s) across "
+        f"{len(ran)} pass(es) — fix them or add a justified allowlist "
+        "entry (k8s_gpu_hpa_tpu/analysis/allowlist.py)",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -30,11 +30,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from k8s_gpu_hpa_tpu.control.capacity import (  # noqa: E402
     POOL_CAPACITY_CHIPS,
+    POOL_FAIR_SHARE_LIMITED,
     POOL_PENDING_PODS,
     POOL_PENDING_SECONDS,
     POOL_PREEMPTIONS,
     POOL_PROVISION_FAILURES,
     POOL_PROVISIONED_NODES,
+    POOL_PROVISIONS,
     POOL_USED_CHIPS,
 )
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
@@ -761,6 +763,90 @@ def build_dashboard() -> dict:
             "provision attempts time out.  Failures with a flat node count "
             "is the provision_fail fault signature — the autoscaler is in "
             "exponential backoff while pods queue.",
+        ),
+        _ts_panel(
+            36,
+            "Capacity pool: fair-share gate and provisions",
+            0,
+            136,
+            [
+                _target(
+                    f"sum by(tenant)({POOL_FAIR_SHARE_LIMITED})",
+                    "limited {{tenant}}",
+                    "A",
+                ),
+                _target(
+                    f"increase({POOL_PROVISIONS}[5m])",
+                    "provisions / 5m",
+                    "B",
+                ),
+            ],
+            "The economy's two relief valves: which tenants the fair-share "
+            "gate is holding at their guaranteed share (1 while limited) and "
+            "successful node provisions per 5m.  A tenant pinned at 1 while "
+            "provisions stay flat is contention the supply side is not "
+            "relieving — the crunch is being arbitrated, not grown out of.",
+        ),
+        _ts_panel(
+            37,
+            "Quantum operator: leadership transitions",
+            12,
+            136,
+            [
+                _target(
+                    "increase(quantum_operator_lease_transitions_total[5m])",
+                    "transitions / 5m",
+                    "A",
+                )
+            ],
+            "Leadership changes the operator replica observed (acquired or "
+            "lost) per 5m.  Steady state is zero; repeated flapping means "
+            "the lease is being contended or renewals are timing out, and "
+            "every transition is a reconcile gap a revert can slip through.",
+            legend=False,
+        ),
+        _ts_panel(
+            38,
+            "Exporter internals: scrape and collect-sweep rates",
+            0,
+            144,
+            [
+                _target(
+                    "sum by(node)"
+                    "(rate(tpu_metrics_exporter_scrapes_total[5m]))",
+                    "scrapes/s {{node}}",
+                    "A",
+                ),
+                _target(
+                    "sum by(node)"
+                    "(rate(tpu_metrics_exporter_collect_sweeps_total[5m]))",
+                    "sweeps/s {{node}}",
+                    "B",
+                ),
+            ],
+            "The exporter's own heartbeat counters: /metrics scrapes served "
+            "and libtpu collect sweeps completed, per node.  Scrapes without "
+            "sweeps is the wedged-collector signature behind TpuExporterStale "
+            "(the cache keeps serving stale samples); sweeps without scrapes "
+            "means Prometheus stopped coming — check the ServiceMonitor.",
+        ),
+        _ts_panel(
+            39,
+            "Per-pod chip power draw (hottest chip)",
+            12,
+            144,
+            [
+                _target(
+                    'max by(pod)(tpu_chip_power_watts{pod!=""})',
+                    "{{pod}}",
+                    "A",
+                )
+            ],
+            "Each pod collapsed to its hottest chip's power draw.  Power is "
+            "the honest utilization signal when tensorcore counters plateau: "
+            "a pod holding near the chip's TDP while its duty cycle reads "
+            "low is feeding off HBM bandwidth, not idling.",
+            unit="watt",
         ),
     ]
     return {

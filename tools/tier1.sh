@@ -7,10 +7,14 @@
 # error counts are expected to only ever go DOWN (e.g. native-toolchain
 # tests now skip cleanly instead of erroring on hosts without cmake).
 set -o pipefail
-# trace-schema lint: the live emitters must still speak obs/schema.py's span
-# table (runs a short traced sim in-process and lints its JSONL export), and
-# every self-metrics histogram exemplar must resolve into that export
-python tools/lint_trace_schema.py --selfcheck || exit 1
+# static-analysis gate: every registered pass under one finding format —
+# the whole-program metrics contract (every consumed series resolves to a
+# producer; no orphans, label or type misuse), the sim-purity lint (no wall
+# clock / unseeded random / ambient threading in sim scope), and the five
+# older lints as adapters (fault-registry, promql-parity, dashboard-parity,
+# trace-schema selfcheck, rollup probe).  `--pass <name>` narrows for local
+# debugging; exemptions live in k8s_gpu_hpa_tpu/analysis/allowlist.py
+python tools/analyze.py --all || exit 1
 # sim_scale smoke: the fleet-scale metrics plane must stay fast (virtual/wall
 # speedup floor) and bounded (retention must keep trimming); small sizing —
 # the full 1000x1h rung runs in bench.py.  All thresholds live in
@@ -23,18 +27,6 @@ python tools/profile_sim.py --smoke --assert-gates || exit 1
 # query p95 budget, the appends/sec floor, and the ring invariants
 # (disjoint shard ownership covering the fleet); thresholds from perfgates
 python tools/profile_sim.py --preset sim_scale_10k --smoke --assert-gates || exit 1
-# fault-registry lint: every chaos fault kind must have an injector, a
-# docstring row, and at least one test referencing it
-python tools/lint_faults.py || exit 1
-# PromQL parity lint: every expr string in the generated PrometheusRule
-# manifest must parse (metrics/promql.py) back to the exact AST the closed
-# loop evaluates, and no rule may exist on only one side
-python tools/lint_promql_parity.py || exit 1
-# rollup-tier probe: age a deterministic DB through the 5m/1h compactor and
-# require the doctor's check_downsampling to pass — every tier holding
-# sealed buckets, rollup folds bit-agreeing with the raw bucketed twin on
-# tier-aligned windows
-python tools/downsample_probe.py || exit 1
 # recovery-drill smoke (small sizing: one component): kill the TSDB mid-run,
 # replay its WAL, and require reconvergence with zero spurious scale events
 # and lineage-complete traces — exit 0 IS the durability contract
