@@ -1751,6 +1751,50 @@ def run_rung_capacity_crunch() -> dict:
     }
 
 
+def run_rung_coverage_floor() -> dict:
+    """Execution-coverage rung (obs/coverage.py): run the four canned
+    scenarios — storm, crunch, drill, slo — under ONE CoverageMap and gate
+    the union against the declared floors (perfgates COVERAGE_*): union hit
+    ratio, per-domain ratios, AND a minimum never-hit count (a gap list
+    that went dark means coverage stopped carrying information).  The
+    never_hit field IS the published gap list — the scenario-authoring work
+    queue.  Virtual time: deterministic run-to-run."""
+    from k8s_gpu_hpa_tpu.obs import coverage
+    from k8s_gpu_hpa_tpu.perfgates import (
+        COVERAGE_DOMAIN_FLOORS,
+        COVERAGE_MIN_NEVER_HIT,
+        COVERAGE_UNION_FLOOR,
+    )
+    from k8s_gpu_hpa_tpu.simulate import run_coverage
+
+    export = run_coverage(run="all")
+    union = coverage.export_union_ratio(export)
+    gaps = coverage.export_never_hit(export)
+    domain_ratios = {
+        d: round(export["domains"][d]["ratio"], 4) for d in coverage.DOMAINS
+    }
+    domains_ok = all(
+        domain_ratios[d] >= COVERAGE_DOMAIN_FLOORS[d] for d in coverage.DOMAINS
+    )
+    return {
+        "mode": "virtual",
+        "metric": "decision-path coverage (canned-scenario union, ratio)",
+        "probes_registered": len(export["probes"]),
+        "probes_hit": len(export["probes"]) - len(gaps),
+        "union_ratio": round(union, 4),
+        "union_floor": COVERAGE_UNION_FLOOR,
+        "domain_ratios": domain_ratios,
+        "domain_floors": dict(COVERAGE_DOMAIN_FLOORS),
+        "never_hit": gaps,
+        "never_hit_min": COVERAGE_MIN_NEVER_HIT,
+        "ok": (
+            union >= COVERAGE_UNION_FLOOR
+            and domains_ok
+            and len(gaps) >= COVERAGE_MIN_NEVER_HIT
+        ),
+    }
+
+
 def run_rung_query_bench() -> dict:
     """Query-engine rung (metrics/planner.py + scale_harness): the fleet
     aggregate rule basket evaluated naive (logical ``Expr.evaluate``) and
@@ -2324,6 +2368,7 @@ def main() -> None:
             ("downsample_bench", run_rung_downsample_bench),
             ("recovery_drill", run_rung_recovery_drill),
             ("capacity_crunch", run_rung_capacity_crunch),
+            ("coverage_floor", run_rung_coverage_floor),
         ):
             log(f"rung {name}:")
             try:

@@ -209,6 +209,7 @@ def main(argv: list[str] | None = None) -> int:
             "slo",
             "history",
             "why",
+            "coverage",
         ],
         default="spike",
     )
@@ -265,6 +266,39 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="override every tenant's starvation budget (seconds) for "
         "--scenario crunch; 0 proves the contract can fail",
+    )
+    sim.add_argument(
+        "--run",
+        default=None,
+        help="which canned run --scenario coverage collects "
+        "(storm, crunch, drill, slo, or all; default all)",
+    )
+    sim.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="schedule-variant seed for --scenario coverage's storm",
+    )
+    sim.add_argument(
+        "--json",
+        dest="json_out",
+        default=None,
+        metavar="PATH",
+        help="write --scenario coverage's canonical export to PATH",
+    )
+    sim.add_argument(
+        "--diff",
+        nargs=2,
+        default=None,
+        metavar=("BASELINE", "CANDIDATE"),
+        help="diff two coverage --json exports; exit 2 on any lost probe",
+    )
+    sim.add_argument(
+        "--floor",
+        type=float,
+        default=None,
+        help="fail --scenario coverage when union coverage lands below "
+        "this (default: the perfgates floor for --run all)",
     )
 
     genm = sub.add_parser(

@@ -44,6 +44,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable
 
+from k8s_gpu_hpa_tpu.obs import coverage
+
 from k8s_gpu_hpa_tpu.metrics.tsdb import (
     ScrapeTarget,
     StructuredExposition,
@@ -377,3 +379,13 @@ FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = 
     "tenant_spike": _inject_tenant_spike,
     "provision_fail": _inject_provision_fail,
 }
+
+
+def inject_fault(pipeline: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """THE injection entry point (ChaosSchedule._inject calls this, not the
+    table): records the fault-kind coverage probe, then dispatches.  The
+    ``fault_kind`` probe family is registry-driven — one probe per key of
+    FAULT_KINDS, kept in sync with obs/coverage.FAULT_PROBE_KINDS by the
+    coverage-probes analyzer pass."""
+    coverage.hit_dynamic("fault_kind", spec.kind)
+    return FAULT_KINDS[spec.kind](pipeline, spec)

@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
-from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, ClearFn, FaultSpec
+from k8s_gpu_hpa_tpu.chaos.faults import ClearFn, FaultSpec, inject_fault
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
@@ -163,7 +163,7 @@ class ChaosSchedule:
         # assert final convergence against it when load is held constant)
         armed.report.expected_replicas = self.pipeline.deployment.replicas
         restarts_before = len(getattr(self.pipeline, "restart_log", []))
-        armed.clear_fn = FAULT_KINDS[armed.spec.kind](self.pipeline, armed.spec)
+        armed.clear_fn = inject_fault(self.pipeline, armed.spec)
         # restart faults leave recovery stats in the pipeline's restart log;
         # the worst replay gap among this fault's restarts goes on the report
         for entry in getattr(self.pipeline, "restart_log", [])[restarts_before:]:

@@ -27,6 +27,8 @@ t (s)      fault                           what must happen
 
 from __future__ import annotations
 
+import random
+
 from k8s_gpu_hpa_tpu.chaos.faults import FaultSpec
 from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
@@ -43,11 +45,53 @@ STORM_FAULTS = [
 ]
 
 
+#: faults the canned storm never arms — the seeded variant draws its extra
+#: fault from this pool, so different seeds explore different schedules
+#: (the mutation axis the ROADMAP-5 fuzzer will drive much harder)
+STORM_EXTRA_FAULT_POOL = ("frozen_samples", "slow_scrape", "pod_crash")
+
+
+def storm_faults_for_seed(seed: int | None) -> list[FaultSpec]:
+    """The storm's fault schedule.  ``seed=None`` (every canned caller) is
+    the fixed STORM_FAULTS table, byte-for-byte the historical timeline.
+    A seed derives a deterministic variant: each fault's start jitters by
+    up to ±10 s and one extra fault from STORM_EXTRA_FAULT_POOL lands in
+    the quiet window after the crashloop — so two runs under one seed are
+    bit-identical while two seeds (usually) exercise different coverage."""
+    if seed is None:
+        return list(STORM_FAULTS)
+    rng = random.Random(seed)
+    faults = [
+        FaultSpec(
+            f.kind,
+            at=max(1.0, f.at + rng.uniform(-10.0, 10.0)),
+            duration=f.duration,
+            target=f.target,
+        )
+        for f in STORM_FAULTS
+    ]
+    extra = rng.choice(STORM_EXTRA_FAULT_POOL)
+    # pod_crash target=None means "first running pod of the pipeline's
+    # deployment" — the right victim regardless of current pod names
+    target = {
+        "frozen_samples": "exporter/chaos-node-2",
+        "slow_scrape": "exporter/chaos-node-2",
+        "pod_crash": None,
+    }[extra]
+    faults.append(
+        FaultSpec(extra, at=rng.uniform(760.0, 820.0), duration=60.0, target=target)
+    )
+    return faults
+
+
 def run_fault_storm(
     pod_start_latency: float = 12.0,
     total: float = 1000.0,
+    seed: int | None = None,
 ) -> dict:
-    """Run the canned storm; returns a JSON-able result dict."""
+    """Run the canned storm; returns a JSON-able result dict.  ``seed``
+    selects a deterministic schedule variant (see storm_faults_for_seed);
+    the default None is the exact historical storm."""
     clock = VirtualClock()
     cluster = SimCluster(
         clock,
@@ -76,7 +120,7 @@ def run_fault_storm(
     clock.advance(120.0)  # settle: shared 90 % over target 40 ⇒ 3 replicas
     settled = pipe.replicas()
 
-    schedule = ChaosSchedule(pipe, STORM_FAULTS)
+    schedule = ChaosSchedule(pipe, storm_faults_for_seed(seed))
     schedule.arm()
     clock.advance(total)
 

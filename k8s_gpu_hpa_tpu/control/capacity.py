@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimNode, SimPod
 from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
+from k8s_gpu_hpa_tpu.obs import coverage
 from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
 
 # ---- pool self-metric names (dashboard / test_manifests contract) ----------
@@ -258,6 +259,7 @@ class ClusterAutoscaler:
             return
         self.in_flight = True
         will_fail = self.failing
+        coverage.hit("scheduler_branch:provision_requested")
         self._event(
             "provision_requested",
             f"{self.node_chips}-chip node, "
@@ -281,6 +283,7 @@ class ClusterAutoscaler:
             self.backoff_base_s * 2.0 ** (self.consecutive_failures - 1),
         )
         self.backoff_until = self.cluster.clock.now() + delay
+        coverage.hit("scheduler_branch:provision_backoff")
         self._event(
             "provision_failed",
             f"failure #{self.consecutive_failures}, backing off {delay:.0f}s",
@@ -294,6 +297,7 @@ class ClusterAutoscaler:
         self.cluster.add_node(name, self.node_chips)
         self.provisioned.append(name)
         self.provisions_total += 1
+        coverage.hit("scheduler_branch:provision_done")
         self._event("provisioned", f"node {name} ({self.node_chips} chips)")
 
     def reap_idle(self, idle_s: float = 120.0) -> list[str]:
@@ -317,6 +321,7 @@ class ClusterAutoscaler:
                 self.provisioned.remove(name)
                 self._empty_since.pop(name, None)
                 reaped.append(name)
+                coverage.hit("scheduler_branch:node_reaped")
                 self._event("node_reaped", f"node {name} idle {idle_s:.0f}s")
         return reaped
 
@@ -516,7 +521,12 @@ class CapacityScheduler:
         self.admission_waits.setdefault(tenant, []).append(wait)
         if self.fair_share_limited.get(tenant):
             self.fair_share_limited[tenant] = False
-        event = "readmitted" if pod.name in self._preempted_pods else "admitted"
+        if pod.name in self._preempted_pods:
+            event = "readmitted"
+            coverage.hit("scheduler_branch:readmitted")
+        else:
+            event = "admitted"
+            coverage.hit("scheduler_branch:admitted")
         self.record_event(
             tenant, pod.name, event, f"node {pod.node}, waited {wait:.1f}s"
         )
@@ -543,6 +553,8 @@ class CapacityScheduler:
                 ):
                     limited = True
                     break
+        if limited:
+            coverage.hit("scheduler_branch:fair_share_gate")
         if limited and not self.fair_share_limited.get(tenant):
             self.record_event(
                 tenant,
@@ -627,6 +639,7 @@ class CapacityScheduler:
         self.preemptions_total += 1
         self.evictions_for[beneficiary] = self.evictions_for.get(beneficiary, 0) + 1
         self._preempted_pods.add(victim.name)
+        coverage.hit("scheduler_branch:preemption_eviction")
         self.record_event(
             tenant,
             victim.name,
@@ -654,6 +667,7 @@ class CapacityScheduler:
         victim.node = None
         victim.chip_ids = []
         victim.phase = "Pending"
+        coverage.hit("scheduler_branch:eviction_requeued")
         self.record_event(
             victim.deployment, victim.name, "evicted", "grace elapsed, re-queued"
         )

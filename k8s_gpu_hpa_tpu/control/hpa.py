@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
 from k8s_gpu_hpa_tpu.control.adapter import CustomMetricsAdapter, ObjectReference
+from k8s_gpu_hpa_tpu.obs import coverage
 from k8s_gpu_hpa_tpu.utils.clock import Clock
 
 
@@ -467,6 +468,7 @@ class HPAController:
             (float(ts), type_, bool(st), reason)
             for ts, type_, st, reason in state.get("condition_history", [])
         ]
+        coverage.hit("hpa_condition:checkpoint_restored")
         return True
 
     # ---- status conditions -------------------------------------------------
@@ -711,6 +713,8 @@ class HPAController:
             return
         probe = self.capacity_probe()
         pending = int(probe.get("pending_pods", 0))
+        if pending > 0:
+            coverage.hit("hpa_condition:unschedulable")
         self._set_condition(
             "Unschedulable",
             pending > 0,
@@ -722,6 +726,8 @@ class HPAController:
             ),
         )
         evicting = int(probe.get("evictions_in_flight", 0))
+        if evicting > 0:
+            coverage.hit("hpa_condition:preempting")
         self._set_condition(
             "Preempting",
             evicting > 0,
@@ -733,6 +739,8 @@ class HPAController:
             ),
         )
         limited = bool(probe.get("fair_share_limited", False))
+        if limited:
+            coverage.hit("hpa_condition:fair_share_limited")
         self._set_condition(
             "FairShareLimited",
             limited,
@@ -760,6 +768,7 @@ class HPAController:
         valid = [p for p in proposals if p is not None]
         if not valid:
             # All metrics unavailable: hold (K8s skips scaling on total failure).
+            coverage.hit("hpa_condition:sync_metrics_unavailable")
             self.status.last_reason = "metrics unavailable; holding"
             self.status.desired_replicas = current
             self._set_condition(
@@ -783,14 +792,17 @@ class HPAController:
         desired = self._stabilized(recommendation)
 
         if desired > current:
+            coverage.hit("hpa_condition:sync_scale_up")
             limit = self._policy_limit(self.behavior.scale_up, current, up=True)
             desired = min(desired, max(limit, current))
             reason = f"scale up {current}->{desired} (policy limit {limit})"
         elif desired < current:
+            coverage.hit("hpa_condition:sync_scale_down")
             limit = self._policy_limit(self.behavior.scale_down, current, up=False)
             desired = max(desired, min(limit, current))
             reason = f"scale down {current}->{desired} (policy limit {limit})"
         else:
+            coverage.hit("hpa_condition:sync_within_tolerance")
             reason = "within tolerance / stabilized"
 
         desired = min(max(desired, self.min_replicas), self.max_replicas)
@@ -806,6 +818,7 @@ class HPAController:
             # them inward (the constructor guarantees max_replicas >= q).
             max_q = self.max_replicas // q * q
             min_q = min(math.ceil(self.min_replicas / q) * q, max_q)
+            coverage.hit("hpa_condition:quantum_round")
             if desired > current:
                 desired = min(math.ceil(desired / q) * q, max_q)
             elif desired < current:
@@ -815,6 +828,7 @@ class HPAController:
                 # scaled, or the HPA adopted a misaligned target): repair by
                 # releasing the stranded hosts — they serve nothing anyway.
                 desired = max(desired // q * q, min_q)
+                coverage.hit("hpa_condition:repair_partial_slice")
                 reason = f"repair partial slice {current}->{desired}"
         if self._proposal_notes:
             reason += " [" + "; ".join(self._proposal_notes) + "]"

@@ -34,6 +34,7 @@ from k8s_gpu_hpa_tpu.metrics.rules import (
     tpu_test_multihost_avg_rule,
 )
 from k8s_gpu_hpa_tpu.metrics.tsdb import Scraper, TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import coverage
 from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
 
 
@@ -75,6 +76,10 @@ class AutoscalingPipeline:
         self.deployment = deployment
         self.intervals = intervals or PipelineIntervals()
         clock: VirtualClock = cluster.clock
+        # Execution-coverage telemetry (obs/coverage.py): when a run is
+        # collecting coverage, first-hit timestamps/spans come from THIS
+        # pipeline's clock and tracer; with no active map this is a no-op.
+        coverage.bind_active(clock, tracer)
 
         # Capacity economy (control/capacity.py): a CapacityConfig installs
         # the bounded SlicePool + priority/fair-share/preemption scheduler
@@ -549,6 +554,7 @@ class AutoscalingPipeline:
     def _log_restart(self, component: str, info: dict) -> dict:
         entry = {"component": component, "at": self._clock.now(), **info}
         self.restart_log.append(entry)
+        coverage.hit("recovery_path:pipeline_component_restarted")
         if self.tracer is not None:
             attrs = {"component": component}
             for key in (

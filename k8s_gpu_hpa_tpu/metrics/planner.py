@@ -44,6 +44,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from k8s_gpu_hpa_tpu.obs import coverage
+
 from k8s_gpu_hpa_tpu.metrics.rules import (
     Absent,
     Aggregate,
@@ -129,8 +131,10 @@ class PlannedSelect(Select):
                 entry[1] = member.series_for(name, matchers)
                 entry[2] = gen
                 stats.series_resolves += 1
+                coverage.hit("planner_path:series_resolve")
             else:
                 stats.series_cache_hits += 1
+                coverage.hit("planner_path:series_cache_hit")
             series_list = entry[1]
             if not series_list:
                 continue
@@ -186,9 +190,11 @@ class _PlannedAvgOverTime(AvgOverTime):
                     self.name, self.matchers, window, at_v, step, stats=stats
                 )
                 if vec is not None:
+                    coverage.hit("planner_path:rollup_tier_read")
                     return vec
             if eligible:
                 stats.rollup_fallbacks += 1
+                coverage.hit("planner_path:rollup_fallback_raw")
             at = at_v
         return db.range_avg(
             self.name,
@@ -211,6 +217,7 @@ class _PlannedHistogramQuantile(HistogramQuantile):
         )
 
     def evaluate(self, db, at: float | None = None) -> Vector:
+        coverage.hit("planner_path:histogram_quantile")
         return self._group(self._bucket.evaluate(db, at))
 
 
@@ -235,6 +242,7 @@ class _PlannedBurnRate(BurnRate):
         )
 
     def _sum_at(self, db, name, matchers, at):
+        coverage.hit("planner_path:burn_rate")
         sel = (
             self._good
             if name == self.good_name and matchers == self.good_matchers
@@ -265,10 +273,12 @@ class QueryPlanner:
     def plan(self, expr: Expr) -> Expr:
         cached = self._plans.get(id(expr))
         if cached is not None and cached[0] is expr:
+            coverage.hit("planner_path:plan_cache_hit")
             return cached[1]
         plan = self._rewrite(expr)
         self._plans[id(expr)] = (expr, plan)
         self.stats.plans_built += 1
+        coverage.hit("planner_path:plan_built")
         return plan
 
     def invalidate(self) -> None:

@@ -530,14 +530,25 @@ class AlertRule:
     def evaluate(
         self, db: TimeSeriesDB, at: float | None = None, plan: Expr | None = None
     ) -> bool:
+        # imported per-call: obs.slo imports this module at its top, so a
+        # module-level obs import here would cycle; after the first call
+        # this is one sys.modules lookup on a per-alert-per-tick path
+        from k8s_gpu_hpa_tpu.obs import coverage
+
         now = db.clock.now() if at is None else at
         if not (self.expr if plan is None else plan).evaluate(db, at):
+            if self.firing:
+                coverage.hit("alert_state:resolved")
             self._pending_since = None
             self.firing = False
             return False
         if self._pending_since is None:
             self._pending_since = now
+            coverage.hit("alert_state:pending")
+        was_firing = self.firing
         self.firing = now - self._pending_since >= self.for_seconds
+        if self.firing and not was_firing:
+            coverage.hit("alert_state:firing")
         return self.firing
 
 

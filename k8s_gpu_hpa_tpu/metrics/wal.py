@@ -40,6 +40,8 @@ import os
 import re
 from pathlib import Path
 
+from k8s_gpu_hpa_tpu.obs import coverage
+
 _SEGMENT_RE = re.compile(r"^wal-(\d{8})\.jsonl$")
 SNAPSHOT_NAME = "snapshot.json"
 
@@ -123,6 +125,7 @@ class WriteAheadLog:
         if self._fh is not None:
             self._fh.close()
             self._seg_index += 1
+            coverage.hit("recovery_path:wal_segment_rotated")
         self._fh = open(self.directory / _segment_name(self._seg_index), "a")
         self._seg_records = 0
 
@@ -150,6 +153,7 @@ class WriteAheadLog:
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, self.directory / SNAPSHOT_NAME)
+        coverage.hit("recovery_path:wal_snapshot_written")
         for idx in covered:
             (self.directory / _segment_name(idx)).unlink(missing_ok=True)
         # next record starts the segment after everything the snapshot covers
@@ -174,6 +178,7 @@ class WriteAheadLog:
         if tear:
             body += '{"op":"append","name":"torn_mid_rec'
         path.write_text(body)
+        coverage.hit("recovery_path:wal_tail_truncated")
         return lost
 
     # ---- read path ---------------------------------------------------------
@@ -191,7 +196,11 @@ class WriteAheadLog:
                 payload = doc["payload"]
                 covered_through = doc["covered_through"]
             except (ValueError, KeyError) as exc:
+                coverage.hit("recovery_path:wal_corruption_detected")
                 raise WALCorruption(f"unreadable snapshot {snap_path}: {exc}") from exc
+            coverage.hit("recovery_path:wal_replay_snapshot")
+        else:
+            coverage.hit("recovery_path:wal_replay_cold")
         records: list[dict] = []
         indices = [i for i in self._segment_indices() if i > covered_through]
         for pos, idx in enumerate(indices):
@@ -206,7 +215,9 @@ class WriteAheadLog:
                 except ValueError as exc:
                     if last_segment and lineno == len(lines) - 1:
                         # the one tear a kill can produce: drop it
+                        coverage.hit("recovery_path:wal_torn_tail_dropped")
                         continue
+                    coverage.hit("recovery_path:wal_corruption_detected")
                     raise WALCorruption(
                         f"torn record mid-log ({path.name}:{lineno + 1})"
                     ) from exc

@@ -13,6 +13,21 @@ bench.py's ``signal_latency``/``slo_burn`` rungs, and the chaos storm's
 span-annotated RecoveryReports.
 """
 
+from k8s_gpu_hpa_tpu.obs.coverage import (
+    COVERAGE_HIT_RATIO,
+    COVERAGE_METRIC_NAMES,
+    COVERAGE_PROBES_HIT,
+    COVERAGE_PROBES_REGISTERED,
+    DOMAINS,
+    PROBES,
+    CoverageMap,
+    Probe,
+    coverage_families,
+    diff_exports,
+    probe_ids,
+    probes_in_domain,
+    render_scorecard,
+)
 from k8s_gpu_hpa_tpu.obs.latency import (
     TracedLoad,
     histogram_quantiles,
@@ -59,13 +74,21 @@ from k8s_gpu_hpa_tpu.obs.trace import Span, Tracer, read_jsonl
 
 __all__ = [
     "ADAPTER_QUERY_LATENCY",
+    "COVERAGE_HIT_RATIO",
+    "COVERAGE_METRIC_NAMES",
+    "COVERAGE_PROBES_HIT",
+    "COVERAGE_PROBES_REGISTERED",
+    "CoverageMap",
     "DECISION_REASONS",
+    "DOMAINS",
     "HPA_DECISION_TOTAL",
     "HPA_SYNC_DURATION",
     "HPA_SYNC_LATENCY",
     "LINEAGE_ORDER",
+    "PROBES",
     "PROPAGATION_BUDGET_SECONDS",
     "PipelineSelfMetrics",
+    "Probe",
     "RULE_EVAL_LATENCY",
     "RULE_EVAL_STALENESS",
     "SCRAPE_DURATION",
@@ -85,14 +108,19 @@ __all__ = [
     "TracedLoad",
     "Tracer",
     "burn_rate_alerts",
+    "coverage_families",
     "decision_reason_label",
+    "diff_exports",
     "format_lineage",
     "histogram_quantiles",
     "index_spans",
     "lineage_of",
     "percentile",
+    "probe_ids",
+    "probes_in_domain",
     "propagation_report",
     "read_jsonl",
+    "render_scorecard",
     "shipped_slo_alerts",
     "shipped_slo_recorders",
     "shipped_slos",

@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.rules import AlertRule, AndOn, BurnRate, Cmp, _fmt_window
 from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import coverage
 from k8s_gpu_hpa_tpu.obs.selfmetrics import SIGNAL_PROPAGATION
 
 #: normalized error-budget counters every SLO records into (label: slo=<name>)
@@ -136,9 +137,11 @@ class SLORecorder:
             self._good = db.latest(SLO_GOOD_TOTAL, dict(self.slo.labels)) or 0.0
             self._total = db.latest(SLO_EVENTS_TOTAL, dict(self.slo.labels)) or 0.0
             self._seeded = True
+            coverage.hit("alert_state:slo_seeded")
         if self.slo.source == "gauge":
             read = self._sum(db, self.slo.good_series, self.slo.good_matchers, ts)
             if read is None:
+                coverage.hit("alert_state:slo_gauge_no_evidence")
                 return 0  # source absent: no evidence this tick, no write
             value_sum, count = read
             self._good += value_sum
@@ -147,11 +150,13 @@ class SLORecorder:
             good = self._sum(db, self.slo.good_series, self.slo.good_matchers, ts)
             total = self._sum(db, self.slo.total_series, self.slo.total_matchers, ts)
             if total is None:
+                coverage.hit("alert_state:slo_counter_missing")
                 return 0  # histogram not scraped yet / expired: skip
             # mirror the source counters, never regress (a source briefly
             # dropping out of the lookback window must not read as a reset)
             self._good = max(self._good, (good or (0.0, 0))[0])
             self._total = max(self._total, total[0])
+        coverage.hit("alert_state:slo_budget_recorded")
         db.append(SLO_GOOD_TOTAL, self.slo.labels, self._good, ts)
         db.append(SLO_EVENTS_TOTAL, self.slo.labels, self._total, ts)
         return 2

@@ -169,3 +169,31 @@ CRUNCH_STARVATION_BUDGETS_S = {
     "tpu-batch": 600.0,
     "tpu-best": 900.0,
 }
+
+# ---- coverage_floor: the execution-coverage rung (ISSUE 11) -----------------
+
+#: union decision-path coverage the four canned scenarios (storm, crunch,
+#: drill, slo) must reach together, as hit-probes / registered-probes
+#: (measured 45/57 ~ 0.79).  The floor is NOT 1.0 on purpose: the never-hit
+#: remainder is the rung's published gap list — the work queue for new
+#: scenarios — so a registry that quietly grows past what the canned runs
+#: exercise widens the printed gap instead of failing the build
+COVERAGE_UNION_FLOOR = 0.70
+
+#: per-domain floors under the same union map, each with margin below the
+#: measured canned-scenario ratio (hpa 0.80, scheduler 1.00, planner 0.625,
+#: fault 0.733, alert 0.857, recovery 0.75) — a scenario edit that stops
+#: exercising a whole domain trips its floor even if the union survives
+COVERAGE_DOMAIN_FLOORS = {
+    "hpa_condition": 0.70,
+    "scheduler_branch": 0.85,
+    "planner_path": 0.50,
+    "fault_kind": 0.65,
+    "alert_state": 0.70,
+    "recovery_path": 0.60,
+}
+
+#: the rung must also PROVE the registry outruns the canned scenarios:
+#: at least this many probes never hit (measured 12) — zero would mean the
+#: gap list went dark and coverage stopped carrying information
+COVERAGE_MIN_NEVER_HIT = 1

@@ -417,6 +417,51 @@ def render_slo_report(result: dict) -> str:
     return "\n".join(lines)
 
 
+#: the canned runs ``simulate coverage`` can collect under one map — the
+#: same four the coverage_floor bench rung unions (bench.py)
+COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo")
+
+
+def run_coverage(run: str = "all", seed: int | None = None) -> dict:
+    """Execute the named canned run(s) under a fresh CoverageMap and return
+    its canonical export.  ``run="all"`` unions all four; ``seed`` feeds the
+    storm's schedule-variant derivation (chaos/storm.py) and is embedded in
+    the run label so same-seed exports are bit-identical and differently-
+    labeled ones are not conflated."""
+    from k8s_gpu_hpa_tpu.chaos.crunch import run_capacity_crunch
+    from k8s_gpu_hpa_tpu.chaos.storm import run_fault_storm
+    from k8s_gpu_hpa_tpu.control.scale_harness import run_recovery_drill
+    from k8s_gpu_hpa_tpu.obs import coverage
+
+    names = COVERAGE_RUN_NAMES if run == "all" else (run,)
+    label = run if seed is None else f"{run}@{seed}"
+    with coverage.collect(label) as cmap:
+        for name in names:
+            if name == "storm":
+                run_fault_storm(seed=seed)
+            elif name == "crunch":
+                run_capacity_crunch()
+            elif name == "drill":
+                run_recovery_drill()
+            elif name == "slo":
+                run_slo_check()
+    return cmap.export()
+
+
+def render_coverage_diff(diff: dict) -> str:
+    lines = []
+    for section in ("gained", "lost", "unchanged"):
+        probes = diff[section]
+        lines.append(f"{section} ({len(probes)}):")
+        lines.extend(f"  {pid}" for pid in probes)
+    lines.append(
+        "verdict: COVERAGE REGRESSION — probes lost"
+        if diff["regression"]
+        else "verdict: OK (superset or equal)"
+    )
+    return "\n".join(lines)
+
+
 def run_external_scenario(
     hpa_doc: dict,
     scenario: str = "spike",
@@ -970,6 +1015,61 @@ def main(args) -> int:
 
     from k8s_gpu_hpa_tpu.control.hpa import ExternalMetricSpec
 
+    if args.scenario == "coverage":
+        # the execution-coverage plane (obs/coverage.py): run the canned
+        # scenario(s) under a CoverageMap and print the per-domain
+        # scorecard + never-hit gap list; --json exports the canonical
+        # map, --diff compares two exports (exit 2 on any lost probe)
+        import json as _json
+
+        from k8s_gpu_hpa_tpu.obs import coverage as covmod
+        from k8s_gpu_hpa_tpu.perfgates import COVERAGE_UNION_FLOOR
+
+        diff_paths = getattr(args, "diff", None)
+        if diff_paths:
+            try:
+                a = _json.loads(Path(diff_paths[0]).read_text())
+                b = _json.loads(Path(diff_paths[1]).read_text())
+            except (OSError, ValueError) as e:
+                print(f"simulate coverage --diff: {e}")
+                return 2
+            diff = covmod.diff_exports(a, b)
+            print(render_coverage_diff(diff))
+            return 2 if diff["regression"] else 0
+
+        run = getattr(args, "run", None) or "all"
+        known = COVERAGE_RUN_NAMES + ("all",)
+        if run not in known:
+            print(
+                f"simulate coverage: unknown run {run!r} — pick one of: "
+                f"{', '.join(known)}"
+            )
+            return 2
+        export = run_coverage(run=run, seed=getattr(args, "seed", None))
+        print(covmod.render_scorecard(export))
+        json_path = getattr(args, "json_out", None)
+        if json_path:
+            Path(json_path).write_text(
+                _json.dumps(export, sort_keys=True, separators=(",", ":"))
+                + "\n"
+            )
+            print(f"wrote {json_path}")
+        # the union floor gates the full union by default (a single run
+        # legitimately covers less); --floor overrides either way
+        floor = getattr(args, "floor", None)
+        if floor is None and run == "all":
+            floor = COVERAGE_UNION_FLOOR
+        if floor is not None:
+            union = covmod.export_union_ratio(export)
+            if union < floor:
+                print(
+                    f"COVERAGE FLOOR VIOLATED: union {union:.3f} < "
+                    f"declared floor {floor:.3f}"
+                )
+                return 2
+            print(f"union {union:.3f} meets declared floor {floor:.3f}")
+        return 0
+
     if args.scenario == "chaos":
         # the storm is manifest-independent by design (see chaos/storm.py):
         # it measures the pipeline's recovery machinery on a fixed cluster,
@@ -1217,6 +1317,7 @@ if __name__ == "__main__":
             "slo",
             "history",
             "why",
+            "coverage",
         ],
     )
     parser.add_argument(
@@ -1266,5 +1367,42 @@ if __name__ == "__main__":
         default=None,
         help="override every tenant's starvation budget (seconds) for the "
         "'crunch' scenario; 0 proves the contract can fail",
+    )
+    parser.add_argument(
+        "--run",
+        default=None,
+        help="which canned run the 'coverage' scenario collects "
+        "(storm, crunch, drill, slo, or all; default all)",
+    )
+    parser.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="schedule-variant seed for the 'coverage' scenario's storm "
+        "(chaos/storm.py); default is the fixed canned timeline",
+    )
+    parser.add_argument(
+        "--json",
+        dest="json_out",
+        default=None,
+        metavar="PATH",
+        help="write the 'coverage' scenario's canonical CoverageMap "
+        "export to PATH (bit-identical across same-seed runs)",
+    )
+    parser.add_argument(
+        "--diff",
+        nargs=2,
+        default=None,
+        metavar=("BASELINE", "CANDIDATE"),
+        help="diff two 'coverage' --json exports instead of running "
+        "anything; exit 2 if the candidate lost any probe",
+    )
+    parser.add_argument(
+        "--floor",
+        type=float,
+        default=None,
+        help="fail (exit 2) when the 'coverage' scenario's union hit "
+        "ratio lands below this; default: the perfgates union floor "
+        "for --run all, no floor for single runs",
     )
     sys.exit(main(parser.parse_args()))

@@ -321,3 +321,47 @@ def test_capacity_crunch_rung_gates_the_full_contract():
     assert result["preemptions"]["tpu-prod"] == 0
     assert result["violations"] == []
     assert result["ok"] is True
+
+
+def test_coverage_floor_rung_gates_union_domains_and_gap_list():
+    """The execution-coverage rung (obs/coverage.py): the four-scenario
+    union must clear every declared floor AND still leave a non-empty
+    never-hit gap list — full coverage would mean the registry stopped
+    outrunning the canned scenarios and the gap list went dark."""
+    import bench as bench_mod
+    from k8s_gpu_hpa_tpu.obs import coverage
+    from k8s_gpu_hpa_tpu.perfgates import (
+        COVERAGE_DOMAIN_FLOORS,
+        COVERAGE_MIN_NEVER_HIT,
+        COVERAGE_UNION_FLOOR,
+    )
+
+    result = bench_mod.run_rung_coverage_floor()
+    # the driver parses these keys verbatim — pin the record shape
+    assert set(result) == {
+        "mode",
+        "metric",
+        "probes_registered",
+        "probes_hit",
+        "union_ratio",
+        "union_floor",
+        "domain_ratios",
+        "domain_floors",
+        "never_hit",
+        "never_hit_min",
+        "ok",
+    }
+    assert result["mode"] == "virtual"
+    assert result["union_floor"] == COVERAGE_UNION_FLOOR
+    assert result["domain_floors"] == COVERAGE_DOMAIN_FLOORS
+    assert result["union_ratio"] >= COVERAGE_UNION_FLOOR
+    assert set(result["domain_ratios"]) == set(coverage.DOMAINS)
+    for domain, ratio in result["domain_ratios"].items():
+        assert ratio >= COVERAGE_DOMAIN_FLOORS[domain], domain
+    assert len(result["never_hit"]) >= COVERAGE_MIN_NEVER_HIT
+    assert all(pid in coverage.PROBES for pid in result["never_hit"])
+    assert (
+        result["probes_hit"]
+        == result["probes_registered"] - len(result["never_hit"])
+    )
+    assert result["ok"] is True

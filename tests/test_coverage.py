@@ -1,0 +1,282 @@
+"""The execution-coverage plane (obs/coverage.py, ISSUE 11): registry
+integrity, map semantics, same-seed determinism, the ``simulate coverage``
+CLI (scorecard / --json / --diff / floors), and the coverage-probes
+analyzer pass.
+
+The determinism clause is the load-bearing one: a CoverageMap export is
+only usable as a fuzzer corpus key and a run-diff baseline if the same
+seed reproduces the same bytes — which in turn rests on the sim-purity
+discipline (no wall clock, no global RNG) the analyzer enforces.
+"""
+
+import json
+from pathlib import Path
+import sys
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_gpu_hpa_tpu.__main__ import main as umbrella_main
+from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS
+from k8s_gpu_hpa_tpu.obs import coverage
+from k8s_gpu_hpa_tpu.simulate import run_coverage
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+def test_registry_ids_are_domain_scoped_and_unique():
+    assert coverage.probe_ids() == sorted(set(coverage.probe_ids()))
+    for pid, probe in coverage.PROBES.items():
+        domain, _, name = pid.partition(":")
+        assert domain == probe.domain and domain in coverage.DOMAINS
+        assert name and probe.description
+    for domain in coverage.DOMAINS:
+        assert coverage.probes_in_domain(domain), f"empty domain {domain}"
+
+
+def test_fault_kind_probes_mirror_the_injector_registry():
+    # the analyzer re-checks this statically; here the live registries
+    assert set(coverage.FAULT_PROBE_KINDS) == set(FAULT_KINDS)
+
+
+# ---- map semantics ----------------------------------------------------------
+
+
+def test_record_rejects_unregistered_probe():
+    cmap = coverage.CoverageMap("t")
+    with pytest.raises(KeyError):
+        cmap.record("hpa_condition:not_a_probe")
+
+
+def test_first_hit_keeps_timestamp_and_count_accumulates():
+    clock = VirtualClock()
+    cmap = coverage.CoverageMap("t")
+    cmap.bind(clock)
+    clock.advance(5.0)
+    cmap.record("hpa_condition:sync_scale_up")
+    clock.advance(5.0)
+    cmap.record("hpa_condition:sync_scale_up")
+    rec = cmap.export()["probes"]["hpa_condition:sync_scale_up"]
+    assert rec["count"] == 2
+    assert rec["first_hit_ts"] == 5.0  # first hit wins; later hits only count
+
+
+def test_hit_is_a_noop_without_an_active_map():
+    # the zero-cost-when-off contract: instrumented joints run in every
+    # perf rung with no map collecting
+    assert coverage.active() is None
+    coverage.hit("hpa_condition:sync_scale_up")
+    coverage.hit_dynamic("fault_kind", "exporter_outage")
+
+
+def test_scorecard_lists_every_domain_and_the_gap_list():
+    with coverage.collect("t") as cmap:
+        coverage.hit("hpa_condition:sync_scale_up")
+    card = coverage.render_scorecard(cmap.export())
+    for domain in coverage.DOMAINS:
+        assert domain in card
+    assert "never-hit probes" in card
+    assert "hpa_condition:sync_scale_down" in card  # in the gap list
+
+
+def test_coverage_families_expose_per_domain_samples():
+    with coverage.collect("t") as cmap:
+        coverage.hit("planner_path:plan_built")
+    families = coverage.coverage_families(cmap.export())
+    assert [f.name for f in families] == list(coverage.COVERAGE_METRIC_NAMES)
+    text = coverage.coverage_exposition(cmap.export())
+    for name in coverage.COVERAGE_METRIC_NAMES:
+        assert name in text
+    assert 'domain="planner_path"' in text
+
+
+# ---- determinism (the property the whole plane rests on) --------------------
+
+
+def test_same_seed_runs_export_bit_identical_maps():
+    a = run_coverage(run="storm", seed=11)
+    b = run_coverage(run="storm", seed=11)
+    dump = lambda e: json.dumps(e, sort_keys=True, separators=(",", ":"))  # noqa: E731
+    assert dump(a) == dump(b)
+
+
+def test_different_storm_seeds_change_the_hit_set():
+    """The map must carry signal: a seeded schedule variant arms one extra
+    fault kind the fixed timeline never does, so some seed's hit set
+    differs from the unseeded storm's."""
+    hit = lambda e: {p for p, r in e["probes"].items() if r["count"]}  # noqa: E731
+    base = hit(run_coverage(run="storm"))
+    assert any(
+        hit(run_coverage(run="storm", seed=s)) != base for s in (1, 2)
+    )
+
+
+# ---- the CLI ----------------------------------------------------------------
+
+
+def _export_with(hits: list[str], run: str = "golden") -> dict:
+    cmap = coverage.CoverageMap(run)
+    for pid in hits:
+        cmap.record(pid)
+    return cmap.export()
+
+
+def test_cli_diff_golden_sections_and_exit_codes(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_export_with(["hpa_condition:sync_scale_up"])))
+    b.write_text(
+        json.dumps(
+            _export_with(
+                ["hpa_condition:sync_scale_up", "planner_path:plan_built"]
+            )
+        )
+    )
+    # candidate is a strict superset: exit 0, the gain named under "gained"
+    rc = umbrella_main(
+        ["simulate", "--scenario", "coverage", "--diff", str(a), str(b)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gained (1):" in out and "planner_path:plan_built" in out
+    assert "lost (0):" in out
+    assert "unchanged" in out
+    assert "verdict: OK" in out
+    # reversed: the candidate lost a probe — regression, exit 2
+    rc = umbrella_main(
+        ["simulate", "--scenario", "coverage", "--diff", str(b), str(a)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "lost (1):" in out and "COVERAGE REGRESSION" in out
+
+
+def test_cli_diff_unreadable_export_is_a_diagnosis(tmp_path, capsys):
+    missing = tmp_path / "nope.json"
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_export_with([])))
+    rc = umbrella_main(
+        ["simulate", "--scenario", "coverage", "--diff", str(missing), str(ok)]
+    )
+    assert rc == 2
+    assert "simulate coverage --diff" in capsys.readouterr().out
+
+
+def test_cli_unknown_run_name_exits_nonzero_with_usable_message(capsys):
+    rc = umbrella_main(
+        ["simulate", "--scenario", "coverage", "--run", "tempest"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "tempest" in out
+    # usable: the message must name every valid choice
+    for name in ("storm", "crunch", "drill", "slo", "all"):
+        assert name in out
+
+
+def test_cli_single_run_writes_canonical_json_and_scores(tmp_path, capsys):
+    out_path = tmp_path / "slo.json"
+    rc = umbrella_main(
+        [
+            "simulate",
+            "--scenario",
+            "coverage",
+            "--run",
+            "slo",
+            "--json",
+            str(out_path),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "coverage scorecard" in out
+    export = json.loads(out_path.read_text())
+    assert export["run"] == "slo"
+    assert set(export["domains"]) == set(coverage.DOMAINS)
+    # canonical form: sorted keys, no whitespace (the bit-identity contract)
+    assert out_path.read_text() == (
+        json.dumps(export, sort_keys=True, separators=(",", ":")) + "\n"
+    )
+    # the slo run alone exercises the alert path but stays under the union
+    # floor — an explicit impossible floor must fail it
+    rc = umbrella_main(
+        [
+            "simulate",
+            "--scenario",
+            "coverage",
+            "--run",
+            "slo",
+            "--floor",
+            "0.99",
+        ]
+    )
+    assert rc == 2
+    assert "COVERAGE FLOOR VIOLATED" in capsys.readouterr().out
+
+
+# ---- the analyzer pass ------------------------------------------------------
+
+
+def test_coverage_probes_pass_is_clean_on_the_repo():
+    from k8s_gpu_hpa_tpu import analysis
+
+    report = analysis.run_passes(["coverage-probes"])
+    assert [f for f in report.findings] == []
+
+
+def _mini_tree(tmp_path: Path, body: str) -> Path:
+    pkg = tmp_path / "k8s_gpu_hpa_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from k8s_gpu_hpa_tpu.obs import coverage\n" + body
+    )
+    return tmp_path
+
+
+def _run_pass(root: Path):
+    from k8s_gpu_hpa_tpu.analysis.coverage import CoverageProbesPass
+
+    return CoverageProbesPass().run(root)
+
+
+#: one hit_dynamic per domain marks every registered probe as covered, so
+#: the mini-tree findings are exactly the defect under test (no orphan noise)
+_COVER_ALL = "".join(
+    f"coverage.hit_dynamic({d!r}, x)\n" for d in coverage.DOMAINS
+)
+
+
+def test_analyzer_flags_dangling_call_site(tmp_path):
+    root = _mini_tree(
+        tmp_path, _COVER_ALL + 'coverage.hit("hpa_condition:typo")\n'
+    )
+    findings = [f for f in _run_pass(root) if f.category == "dangling-call-site"]
+    assert len(findings) == 1
+    assert "hpa_condition:typo" in findings[0].subject
+
+
+def test_analyzer_flags_non_literal_probe_arg(tmp_path):
+    root = _mini_tree(tmp_path, _COVER_ALL + "coverage.hit(some_var)\n")
+    findings = [f for f in _run_pass(root) if f.category == "non-literal-probe"]
+    assert len(findings) == 1
+
+
+def test_analyzer_flags_orphan_probes(tmp_path):
+    # a tree with no call sites at all: every registered probe is an orphan
+    root = _mini_tree(tmp_path, "")
+    orphans = {
+        f.subject for f in _run_pass(root) if f.category == "orphan-probe"
+    }
+    assert orphans == {f"probe:{pid}" for pid in coverage.PROBES}
+
+
+def test_analyzer_flags_unknown_dynamic_domain(tmp_path):
+    root = _mini_tree(
+        tmp_path, _COVER_ALL + 'coverage.hit_dynamic("not_a_domain", x)\n'
+    )
+    findings = [f for f in _run_pass(root) if f.category == "dangling-call-site"]
+    assert len(findings) == 1
+    assert "not_a_domain" in findings[0].subject

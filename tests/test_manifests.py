@@ -182,6 +182,7 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
 
     from k8s_gpu_hpa_tpu.control.capacity import POOL_METRIC_NAMES
     from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
+    from k8s_gpu_hpa_tpu.obs.coverage import COVERAGE_METRIC_NAMES
     from k8s_gpu_hpa_tpu.obs.selfmetrics import (
         SELF_HISTOGRAM_SERIES,
         SELF_METRIC_NAMES,
@@ -232,6 +233,9 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         # capacity-pool self-metrics (control/capacity.py, the capacity-pool
         # scrape target) — single-sourced so a rename breaks this test
         | set(POOL_METRIC_NAMES)
+        # execution-coverage self-metrics (obs/coverage.py, the Coverage
+        # row) — single-sourced so a rename breaks this test
+        | set(COVERAGE_METRIC_NAMES)
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
     assert exprs, "dashboard has no queries"

@@ -40,6 +40,11 @@ from k8s_gpu_hpa_tpu.control.capacity import (  # noqa: E402
     POOL_USED_CHIPS,
 )
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
+from k8s_gpu_hpa_tpu.obs.coverage import (  # noqa: E402
+    COVERAGE_HIT_RATIO,
+    COVERAGE_PROBES_HIT,
+    COVERAGE_PROBES_REGISTERED,
+)
 from k8s_gpu_hpa_tpu.obs.selfmetrics import (  # noqa: E402
     ADAPTER_QUERY_LATENCY,
     DECODE_CACHE_HITS,
@@ -847,6 +852,51 @@ def build_dashboard() -> dict:
             "a pod holding near the chip's TDP while its duty cycle reads "
             "low is feeding off HBM bandwidth, not idling.",
             unit="watt",
+        ),
+        # ---- execution coverage (obs/coverage.py): how much of the
+        # pipeline's decision surface the last run actually exercised ----
+        _ts_panel(
+            40,
+            "Coverage: probes hit vs registered",
+            0,
+            152,
+            [
+                _target(
+                    f"sum({COVERAGE_PROBES_HIT})",
+                    "hit",
+                    "A",
+                ),
+                _target(
+                    f"sum({COVERAGE_PROBES_REGISTERED})",
+                    "registered",
+                    "B",
+                ),
+            ],
+            "Decision-path probes hit by the most recent coverage run vs "
+            "the registry total (obs/coverage.py).  The gap between the two "
+            "lines IS the never-hit list the coverage_floor rung prints — "
+            "registered climbing while hit stays flat means instrumentation "
+            "is outrunning the scenarios.",
+        ),
+        _ts_panel(
+            41,
+            "Coverage: per-domain hit ratio",
+            12,
+            152,
+            [
+                _target(
+                    f"{COVERAGE_HIT_RATIO}",
+                    "{{domain}}",
+                    "A",
+                )
+            ],
+            "Hit ratio per probe domain (hpa_condition, scheduler_branch, "
+            "planner_path, fault_kind, alert_state, recovery_path).  The "
+            "red line marks the union floor the coverage_floor rung gates "
+            "on; one domain collapsing while the rest hold means a scenario "
+            "edit stopped exercising that subsystem.",
+            threshold=0.70,
+            max_y=1,
         ),
     ]
     return {

@@ -36,4 +36,9 @@ python -m k8s_gpu_hpa_tpu.simulate drill --components tsdb || exit 1
 # (pool conserved every tick, TTC p95 inside the priority-band gates, no
 # starvation past declared budgets, full convergence after the crunch)
 python -m k8s_gpu_hpa_tpu.simulate crunch || exit 1
+# coverage smoke (small sizing: the drill run only): the execution-coverage
+# plane must collect, score, and render without tripping a probe KeyError —
+# the full four-scenario union vs the perfgates floors runs in bench.py's
+# coverage_floor rung
+python -m k8s_gpu_hpa_tpu.simulate coverage --run drill || exit 1
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
