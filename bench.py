@@ -1752,8 +1752,8 @@ def run_rung_capacity_crunch() -> dict:
 
 
 def run_rung_coverage_floor() -> dict:
-    """Execution-coverage rung (obs/coverage.py): run the four canned
-    scenarios — storm, crunch, drill, slo — under ONE CoverageMap and gate
+    """Execution-coverage rung (obs/coverage.py): run the five canned
+    scenarios — storm, crunch, drill, slo, races — under ONE CoverageMap and gate
     the union against the declared floors (perfgates COVERAGE_*): union hit
     ratio, per-domain ratios, AND a minimum never-hit count (a gap list
     that went dark means coverage stopped carrying information).  The

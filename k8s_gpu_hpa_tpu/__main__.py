@@ -210,6 +210,7 @@ def main(argv: list[str] | None = None) -> int:
             "history",
             "why",
             "coverage",
+            "races",
         ],
         default="spike",
     )
@@ -271,13 +272,27 @@ def main(argv: list[str] | None = None) -> int:
         "--run",
         default=None,
         help="which canned run --scenario coverage collects "
-        "(storm, crunch, drill, slo, or all; default all)",
+        "(storm, crunch, drill, slo, races, or all; default all)",
     )
     sim.add_argument(
         "--seed",
         type=int,
         default=None,
-        help="schedule-variant seed for --scenario coverage's storm",
+        help="schedule-variant seed for --scenario coverage's storm and "
+        "the races schedule permutations",
+    )
+    sim.add_argument(
+        "--schedules",
+        type=int,
+        default=None,
+        help="permuted completion schedules --scenario races sweeps "
+        "(default: perfgates.RACE_SWEEP_SCHEDULES)",
+    )
+    sim.add_argument(
+        "--break-ordering",
+        action="store_true",
+        help="races: arm the test-only ordering canary (proves the "
+        "harness can fail)",
     )
     sim.add_argument(
         "--json",

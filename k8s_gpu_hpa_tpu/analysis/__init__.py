@@ -200,3 +200,4 @@ from k8s_gpu_hpa_tpu.analysis import contracts as _contracts  # noqa: E402,F401
 from k8s_gpu_hpa_tpu.analysis import purity as _purity  # noqa: E402,F401
 from k8s_gpu_hpa_tpu.analysis import legacy as _legacy  # noqa: E402,F401
 from k8s_gpu_hpa_tpu.analysis import coverage as _coverage  # noqa: E402,F401
+from k8s_gpu_hpa_tpu.analysis import concurrency as _concurrency  # noqa: E402,F401

@@ -76,19 +76,8 @@ ALLOWLIST: tuple[AllowEntry, ...] = (
         "the operator daemon's production serve loop; sims drive "
         "reconcile_once on a VirtualClock instead",
     ),
-    AllowEntry(
-        "sim-purity",
-        "ambient-threading",
-        "k8s_gpu_hpa_tpu/control/operator.py:threading.Thread",
-        "the operator daemon's production health endpoint; never started "
-        "in sim runs",
-    ),
-    AllowEntry(
-        "sim-purity",
-        "ambient-threading",
-        "k8s_gpu_hpa_tpu/metrics/federation.py:concurrent.futures.ThreadPoolExecutor",
-        "the declared shard fan-out: scrape shards are partitioned "
-        "deterministically; merge order is sorted, so results are "
-        "order-independent",
-    ),
+    # Thread boundaries are no longer allowlisted here: each one carries a
+    # structured, machine-checked ConcurrencyContract in
+    # analysis/concurrency.py (the passes verify the contract's invariant
+    # and fail loudly when it goes stale — a blanket entry verified nothing).
 )

@@ -17,8 +17,10 @@ from the process-global RNG, or spawns ambient threads:
   no seed is not.
 - **ambient-threading**: ``threading.Thread``/``Timer`` and executor pools
   introduce scheduling nondeterminism.  Locks are fine (deterministic
-  under a single thread); the declared shard fan-out and the production
-  daemons are exempted by name in ``analysis/allowlist.py``.
+  under a single thread); a thread-construct call site is only tolerated
+  when a structured :class:`~.concurrency.ConcurrencyContract` declares
+  the boundary — and the concurrency passes then *verify* that contract
+  (blanket allowlist entries for threading are gone as of PR 12).
 
 Scope is the simulation core — ``metrics/``, ``control/``, ``chaos/``,
 ``obs/``, ``utils/``, ``simulate.py`` — not the production workload
@@ -212,6 +214,10 @@ class SimPurityPass(AnalysisPass):
         self.config = config or PurityConfig()
 
     def run(self, root: Path) -> list[Finding]:
+        # Imported lazily: analysis/__init__ registers this pass before the
+        # concurrency module (which holds the contracts) is importable.
+        from k8s_gpu_hpa_tpu.analysis.concurrency import contract_for
+
         findings: list[Finding] = []
         for entry in self.config.scope:
             base = root / entry
@@ -223,6 +229,13 @@ class SimPurityPass(AnalysisPass):
                 for qual, line, category, remedy, subject in scan_purity_file(
                     path, root
                 ):
+                    if (
+                        category == "ambient-threading"
+                        and contract_for(rel, qual) is not None
+                    ):
+                        # Declared boundary: the concurrency passes verify
+                        # the contract instead of a blanket exemption.
+                        continue
                     findings.append(
                         self.finding(
                             category,

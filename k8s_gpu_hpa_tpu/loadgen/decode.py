@@ -299,7 +299,10 @@ class DecodeLoadGen:
         # accounting starts after compile (compile time is not load)
         self._steps = 0
         self._busy = 0.0
-        self._history = []
+        # every other _history access holds _hist_lock (stats() races the
+        # step loop); warmup resetting it bare was an inconsistent lockset
+        with self._hist_lock:
+            self._history = []
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.window

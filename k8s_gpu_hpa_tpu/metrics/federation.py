@@ -40,6 +40,7 @@ from typing import Callable
 from k8s_gpu_hpa_tpu.metrics.rules import RecordingRule, RuleEvaluator
 from k8s_gpu_hpa_tpu.metrics.schema import Exemplar, Sample
 from k8s_gpu_hpa_tpu.metrics.tsdb import LabelSet, Scraper, ScrapeTarget, TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import coverage
 
 
 class HashRing:
@@ -201,7 +202,15 @@ class ShardedScrapePlane:
                 for ev in evaluators
             )
         ):
+            if len(evaluators) >= 2:
+                # a genuine fallback (shared sink or parallelism off), not
+                # the trivial 0/1-shard case
+                coverage.hit("concurrency:shard_rules_serial_fallback")
             return sum(ev.evaluate_once() for ev in evaluators)
+        # concurrency contract: disjoint-ownership fan-out, see
+        # analysis/concurrency.py CONTRACTS (verified every analyze run;
+        # the race harness asserts bit-identity with the serial loop)
+        coverage.hit("concurrency:shard_rules_parallel")
         pool = self._rule_pool
         if pool is None or pool._max_workers < len(evaluators):
             if pool is not None:

@@ -35,6 +35,7 @@ will target), and the ``tpu_sim_coverage_*`` self-metric families.
 from __future__ import annotations
 
 import json
+import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
 
@@ -46,6 +47,7 @@ DOMAINS = (
     "fault_kind",
     "alert_state",
     "recovery_path",
+    "concurrency",
 )
 
 EXPORT_VERSION = 1
@@ -289,6 +291,34 @@ probe(
     "a pipeline component was torn down and rebuilt mid-run",
 )
 
+# -- concurrency: thread-boundary joints + the race harness's schedule space
+probe(
+    "concurrency",
+    "shard_rules_parallel",
+    "shard rule evaluation fanned out on the ThreadPoolExecutor",
+)
+probe(
+    "concurrency",
+    "shard_rules_serial_fallback",
+    "shard rule evaluation fell back to the serial loop (shared "
+    "tracer/selfmetrics sink or parallelism disabled)",
+)
+probe(
+    "concurrency",
+    "race_schedule_serial",
+    "race harness evaluated the serial reference schedule",
+)
+probe(
+    "concurrency",
+    "race_schedule_permuted",
+    "race harness evaluated a seeded permuted completion schedule",
+)
+probe(
+    "concurrency",
+    "lockset_assert_armed",
+    "race harness armed the instrumented lock over the inferred lockset",
+)
+
 
 def probe_ids() -> list[str]:
     """Every registered id, sorted (the canonical export order)."""
@@ -316,6 +346,11 @@ class CoverageMap:
         self.counts: dict[str, int] = {}
         self.first_hit_ts: dict[str, float | None] = {}
         self.first_hit_span: dict[str, int | None] = {}
+        # hit() fires from shard-rules pool threads (planner/rule probes);
+        # record()'s check-then-set over three dicts must be atomic or
+        # first-hit provenance races.  Declared lock-guarded in the
+        # federation ConcurrencyContract (analysis/concurrency.py).
+        self._lock = threading.Lock()
         self._clock = None
         self._tracer = None
 
@@ -330,19 +365,20 @@ class CoverageMap:
                 "it in obs/coverage.py (the coverage-probes analyzer pass "
                 "catches this statically)"
             )
-        count = self.counts.get(probe_id)
-        if count is None:
-            self.counts[probe_id] = 1
-            self.first_hit_ts[probe_id] = (
-                None if self._clock is None else self._clock.now()
-            )
-            tracer = self._tracer
-            spans = None if tracer is None else tracer.spans
-            self.first_hit_span[probe_id] = (
-                spans[-1].span_id if spans else None
-            )
-        else:
-            self.counts[probe_id] = count + 1
+        with self._lock:
+            count = self.counts.get(probe_id)
+            if count is None:
+                self.counts[probe_id] = 1
+                self.first_hit_ts[probe_id] = (
+                    None if self._clock is None else self._clock.now()
+                )
+                tracer = self._tracer
+                spans = None if tracer is None else tracer.spans
+                self.first_hit_span[probe_id] = (
+                    spans[-1].span_id if spans else None
+                )
+            else:
+                self.counts[probe_id] = count + 1
 
     # ---- export / summary --------------------------------------------------
 

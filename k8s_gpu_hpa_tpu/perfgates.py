@@ -172,8 +172,8 @@ CRUNCH_STARVATION_BUDGETS_S = {
 
 # ---- coverage_floor: the execution-coverage rung (ISSUE 11) -----------------
 
-#: union decision-path coverage the four canned scenarios (storm, crunch,
-#: drill, slo) must reach together, as hit-probes / registered-probes
+#: union decision-path coverage the five canned scenarios (storm, crunch,
+#: drill, slo, races) must reach together, as hit-probes / registered-probes
 #: (measured 45/57 ~ 0.79).  The floor is NOT 1.0 on purpose: the never-hit
 #: remainder is the rung's published gap list — the work queue for new
 #: scenarios — so a registry that quietly grows past what the canned runs
@@ -191,7 +191,21 @@ COVERAGE_DOMAIN_FLOORS = {
     "fault_kind": 0.65,
     "alert_state": 0.70,
     "recovery_path": 0.60,
+    # the races run drives all five probes (serial + permuted schedules,
+    # parallel + fallback branches, armed lockset); measured 1.00
+    "concurrency": 0.80,
 }
+
+# ---- race_sweep smoke (tools/tier1.sh, `simulate races`) -------------------
+#: permuted completion schedules per sweep; each must be bit-identical to
+#: the serial reference (4 is the tier-1 floor, tests push ≥ 8)
+RACE_SWEEP_SCHEDULES = 4
+#: shards in the sweep's plane — enough for a nontrivial permutation space
+RACE_SWEEP_SHARDS = 4
+#: synthetic fleet targets spread over the ring
+RACE_SWEEP_TARGETS = 12
+#: scrape+evaluate ticks per schedule
+RACE_SWEEP_TICKS = 6
 
 #: the rung must also PROVE the registry outruns the canned scenarios:
 #: at least this many probes never hit (measured 12) — zero would mean the

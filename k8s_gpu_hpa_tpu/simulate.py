@@ -418,18 +418,19 @@ def render_slo_report(result: dict) -> str:
 
 
 #: the canned runs ``simulate coverage`` can collect under one map — the
-#: same four the coverage_floor bench rung unions (bench.py)
-COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo")
+#: same five the coverage_floor bench rung unions (bench.py)
+COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo", "races")
 
 
 def run_coverage(run: str = "all", seed: int | None = None) -> dict:
     """Execute the named canned run(s) under a fresh CoverageMap and return
-    its canonical export.  ``run="all"`` unions all four; ``seed`` feeds the
+    its canonical export.  ``run="all"`` unions all five; ``seed`` feeds the
     storm's schedule-variant derivation (chaos/storm.py) and is embedded in
     the run label so same-seed exports are bit-identical and differently-
     labeled ones are not conflated."""
     from k8s_gpu_hpa_tpu.chaos.crunch import run_capacity_crunch
     from k8s_gpu_hpa_tpu.chaos.storm import run_fault_storm
+    from k8s_gpu_hpa_tpu.control.race_harness import run_race_sweep
     from k8s_gpu_hpa_tpu.control.scale_harness import run_recovery_drill
     from k8s_gpu_hpa_tpu.obs import coverage
 
@@ -445,6 +446,8 @@ def run_coverage(run: str = "all", seed: int | None = None) -> dict:
                 run_recovery_drill()
             elif name == "slo":
                 run_slo_check()
+            elif name == "races":
+                run_race_sweep(seed=0 if seed is None else seed)
     return cmap.export()
 
 
@@ -1140,6 +1143,25 @@ def main(args) -> int:
         print(render_slo_report(result))
         return 0 if result["ok"] else 2
 
+    if args.scenario == "races":
+        # deterministic-interleaving race harness (control/race_harness.py):
+        # serial reference + N seeded permuted schedules of the shard-rules
+        # fan-out must produce bit-identical shard DBs, with the statically
+        # inferred lockset armed as runtime assertions.  Exits non-zero on
+        # any divergence or lock-discipline violation.
+        from k8s_gpu_hpa_tpu.control.race_harness import (
+            render_race_report,
+            run_race_sweep,
+        )
+
+        result = run_race_sweep(
+            schedules=getattr(args, "schedules", None),
+            seed=args.seed if args.seed is not None else 0,
+            break_ordering=getattr(args, "break_ordering", False),
+        )
+        print(render_race_report(result))
+        return 0 if result["ok"] else 2
+
     if args.scenario == "history":
         # the flight recorder: multi-day diurnal run summarized from the
         # rollup tiers, with a mid-run TSDB crash+WAL-replay — exits
@@ -1318,6 +1340,7 @@ if __name__ == "__main__":
             "history",
             "why",
             "coverage",
+            "races",
         ],
     )
     parser.add_argument(
@@ -1372,14 +1395,28 @@ if __name__ == "__main__":
         "--run",
         default=None,
         help="which canned run the 'coverage' scenario collects "
-        "(storm, crunch, drill, slo, or all; default all)",
+        "(storm, crunch, drill, slo, races, or all; default all)",
     )
     parser.add_argument(
         "--seed",
         type=int,
         default=None,
         help="schedule-variant seed for the 'coverage' scenario's storm "
-        "(chaos/storm.py); default is the fixed canned timeline",
+        "(chaos/storm.py) and the 'races' schedule permutations; default "
+        "is the fixed canned timeline (races: seed 0)",
+    )
+    parser.add_argument(
+        "--schedules",
+        type=int,
+        default=None,
+        help="permuted completion schedules the 'races' scenario sweeps "
+        "(default: perfgates.RACE_SWEEP_SCHEDULES)",
+    )
+    parser.add_argument(
+        "--break-ordering",
+        action="store_true",
+        help="races: arm the test-only ordering canary that makes the "
+        "merge schedule-dependent — proves the harness can fail",
     )
     parser.add_argument(
         "--json",

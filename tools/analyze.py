@@ -2,13 +2,16 @@
 
 ``--all`` is the tier-1 invocation — every registered pass, nonzero exit
 on any unsuppressed finding.  ``--pass <name>`` (repeatable) selects
-passes for local debugging; ``--list`` enumerates the registry without
-running anything; ``--json`` emits the machine-readable report
-``tests/test_analysis_contract.py`` pins.
+passes for local debugging; a name matching a registered prefix group
+expands to every pass under it (``--pass concurrency`` runs both
+``concurrency-lockset`` and ``concurrency-escape``).  ``--list``
+enumerates the registry without running anything; ``--json`` emits the
+machine-readable report ``tests/test_analysis_contract.py`` pins.
 
 Usage:
     python tools/analyze.py --all [--json]
     python tools/analyze.py --pass metrics-contract [--pass sim-purity] [--json]
+    python tools/analyze.py --pass concurrency [--json]
     python tools/analyze.py --list [--json]
 """
 
@@ -53,6 +56,15 @@ def main(argv: list[str]) -> int:
     ) and len(argv) % 2 == 0:
         names = argv[1::2]
         known = {p.name for p in analysis.registered_passes()}
+        # prefix-group expansion: "concurrency" -> every concurrency-* pass
+        expanded: list[str] = []
+        for n in names:
+            group = sorted(k for k in known if k.startswith(n + "-"))
+            if n not in known and group:
+                expanded.extend(group)
+            else:
+                expanded.append(n)
+        names = expanded
         unknown = [n for n in names if n not in known]
         if unknown:
             print(

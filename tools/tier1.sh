@@ -10,10 +10,14 @@ set -o pipefail
 # static-analysis gate: every registered pass under one finding format —
 # the whole-program metrics contract (every consumed series resolves to a
 # producer; no orphans, label or type misuse), the sim-purity lint (no wall
-# clock / unseeded random / ambient threading in sim scope), and the five
-# older lints as adapters (fault-registry, promql-parity, dashboard-parity,
-# trace-schema selfcheck, rollup probe).  `--pass <name>` narrows for local
-# debugging; exemptions live in k8s_gpu_hpa_tpu/analysis/allowlist.py
+# clock / unseeded random / ambient threading in sim scope), the
+# concurrency-safety plane (lockset inference + closure-escape analysis,
+# every thread boundary covered by a checked ConcurrencyContract — see
+# analysis/concurrency.py), and the five older lints as adapters
+# (fault-registry, promql-parity, dashboard-parity, trace-schema selfcheck,
+# rollup probe).  `--pass <name>` narrows for local debugging ("concurrency"
+# expands to both concurrency-* passes); exemptions live in
+# k8s_gpu_hpa_tpu/analysis/allowlist.py
 python tools/analyze.py --all || exit 1
 # sim_scale smoke: the fleet-scale metrics plane must stay fast (virtual/wall
 # speedup floor) and bounded (retention must keep trimming); small sizing —
@@ -41,4 +45,11 @@ python -m k8s_gpu_hpa_tpu.simulate crunch || exit 1
 # the full four-scenario union vs the perfgates floors runs in bench.py's
 # coverage_floor rung
 python -m k8s_gpu_hpa_tpu.simulate coverage --run drill || exit 1
+# race_sweep smoke: serial-vs-pooled bit-identity of the shard-rules
+# fan-out under RACE_SWEEP_SCHEDULES seeded permuted completion schedules
+# (plus one real-thread pass), with the statically inferred lockset armed
+# as runtime assertions — nonzero exit on any divergence or lock-discipline
+# violation (control/race_harness.py; the dynamic half of the concurrency
+# passes above)
+python -m k8s_gpu_hpa_tpu.simulate races || exit 1
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
