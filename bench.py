@@ -1752,8 +1752,8 @@ def run_rung_capacity_crunch() -> dict:
 
 
 def run_rung_coverage_floor() -> dict:
-    """Execution-coverage rung (obs/coverage.py): run the five canned
-    scenarios — storm, crunch, drill, slo, races — under ONE CoverageMap and gate
+    """Execution-coverage rung (obs/coverage.py): run the six canned
+    scenarios — storm, crunch, drill, slo, races, fuzz — under ONE CoverageMap and gate
     the union against the declared floors (perfgates COVERAGE_*): union hit
     ratio, per-domain ratios, AND a minimum never-hit count (a gap list
     that went dark means coverage stopped carrying information).  The
@@ -1791,6 +1791,74 @@ def run_rung_coverage_floor() -> dict:
             union >= COVERAGE_UNION_FLOOR
             and domains_ok
             and len(gaps) >= COVERAGE_MIN_NEVER_HIT
+        ),
+    }
+
+
+def run_rung_chaos_fuzz() -> dict:
+    """Adversarial-fuzzing rung (chaos/fuzz.py): the three guarantees the
+    corpus/replay design rests on, each gated by perfgates FUZZ_*:
+
+    - **determinism** — the same seeded exploration campaign run twice must
+      produce bit-identical reports (canonical JSON compared), or no
+      committed scenario can be trusted to replay;
+    - **novelty** — the campaign must accept at least FUZZ_MIN_NOVEL_ACCEPTS
+      mutations for previously-unseen coverage (a mutator that stopped
+      diversifying lands at 0-1);
+    - **canary** — with --break-grace armed the fuzzer must FIND a failing
+      schedule within FUZZ_CANARY_BUDGET cases, prove it reproduces, and
+      minimize it to at most FUZZ_MAX_SHRINK_RATIO of the original faults
+      (or an already-minimal <=2-fault core).
+
+    Virtual time throughout; deterministic run-to-run."""
+    import json as _json
+
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.chaos.fuzz import run_fuzz
+
+    first = run_fuzz(
+        budget=perfgates.FUZZ_RUNG_BUDGET, seed=perfgates.FUZZ_RUNG_SEED
+    )
+    second = run_fuzz(
+        budget=perfgates.FUZZ_RUNG_BUDGET, seed=perfgates.FUZZ_RUNG_SEED
+    )
+    canon = lambda r: _json.dumps(r, sort_keys=True, separators=(",", ":"))  # noqa: E731
+    bit_identical = canon(first) == canon(second)
+
+    canary = run_fuzz(
+        budget=perfgates.FUZZ_CANARY_BUDGET,
+        seed=perfgates.FUZZ_CANARY_SEED,
+        break_grace=True,
+    )
+    failure = canary["failure"]
+    canary_found = failure is not None and failure["reproducible"]
+    minimized = failure["minimized"] if canary_found else None
+    shrink = failure["shrink_ratio"] if canary_found else None
+    canary_minimized = minimized is not None and (
+        shrink <= perfgates.FUZZ_MAX_SHRINK_RATIO
+        or len(minimized["faults"]) <= 2
+    )
+    return {
+        "mode": "virtual",
+        "metric": "fuzz campaign determinism + canary find/minimize",
+        "budget": perfgates.FUZZ_RUNG_BUDGET,
+        "seed": perfgates.FUZZ_RUNG_SEED,
+        "bit_identical": bit_identical,
+        "novel_accepts": first["novel_accepts"],
+        "novel_accepts_min": perfgates.FUZZ_MIN_NOVEL_ACCEPTS,
+        "canary_budget": perfgates.FUZZ_CANARY_BUDGET,
+        "canary_found": canary_found,
+        "canary_minimized": canary_minimized,
+        "canary_shrink_ratio": shrink,
+        "shrink_ratio_max": perfgates.FUZZ_MAX_SHRINK_RATIO,
+        "canary_minimized_faults": (
+            len(minimized["faults"]) if minimized is not None else None
+        ),
+        "ok": (
+            bit_identical
+            and first["novel_accepts"] >= perfgates.FUZZ_MIN_NOVEL_ACCEPTS
+            and canary_found
+            and canary_minimized
         ),
     }
 
@@ -2369,8 +2437,17 @@ def main() -> None:
             ("recovery_drill", run_rung_recovery_drill),
             ("capacity_crunch", run_rung_capacity_crunch),
             ("coverage_floor", run_rung_coverage_floor),
+            ("chaos_fuzz", run_rung_chaos_fuzz),
         ):
             log(f"rung {name}:")
+            # chaos_fuzz is the one virtual rung whose WALL cost is minutes
+            # (three full campaigns: determinism twice + the canary proof):
+            # under a tight BENCH_TIME_BUDGET_S it becomes a labeled skip
+            # like the kernel dwells — the summary line still names it
+            if name == "chaos_fuzz" and remaining_budget() < 360.0:
+                rungs[name] = {"mode": "virtual", "skipped": "time budget"}
+                log("  skipped: time budget")
+                continue
             try:
                 rungs[name] = fn()
                 log(f"  {rungs[name]}")

@@ -211,6 +211,7 @@ def main(argv: list[str] | None = None) -> int:
             "why",
             "coverage",
             "races",
+            "fuzz",
         ],
         default="spike",
     )
@@ -272,14 +273,41 @@ def main(argv: list[str] | None = None) -> int:
         "--run",
         default=None,
         help="which canned run --scenario coverage collects "
-        "(storm, crunch, drill, slo, races, or all; default all)",
+        "(storm, crunch, drill, slo, races, fuzz, or all; default all)",
     )
     sim.add_argument(
         "--seed",
         type=int,
         default=None,
-        help="schedule-variant seed for --scenario coverage's storm and "
-        "the races schedule permutations",
+        help="schedule-variant seed for --scenario coverage's storm, "
+        "the races schedule permutations, and the fuzz campaign",
+    )
+    sim.add_argument(
+        "--budget",
+        type=int,
+        default=None,
+        help="fuzz: exploration cases the campaign runs "
+        "(default perfgates.FUZZ_SMOKE_BUDGET)",
+    )
+    sim.add_argument(
+        "--replay",
+        default=None,
+        metavar="SCENARIO_JSON",
+        help="fuzz: replay a committed corpus artifact instead of "
+        "searching; exit 2 unless it reproduces bit-identically",
+    )
+    sim.add_argument(
+        "--break-grace",
+        action="store_true",
+        help="fuzz: arm the test-only canary (eviction grace stretched to "
+        "forever) — proves the fuzzer can find and minimize a failure",
+    )
+    sim.add_argument(
+        "--fuzz-out",
+        default=None,
+        metavar="DIR",
+        help="fuzz: write the minimized failure's replayable artifact "
+        "under DIR",
     )
     sim.add_argument(
         "--schedules",

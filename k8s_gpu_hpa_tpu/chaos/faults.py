@@ -37,6 +37,15 @@ faults (``pod_crash``, the restart kinds) are impulses and clear immediately.
 ``clear()`` is idempotent and safe under overlapping fault windows: a
 scrape-path target is restored to its pristine fetch only when the LAST
 overlapping fault over it clears, whatever order the windows close in.
+The same per-resource depth-counter discipline covers the node kinds
+(``node_preempt``/``node_drain`` restore a node only when its last window
+closes), ``crashloop`` (the loop stops when the last overlapping window
+over that deployment clears), and ``adapter_blackout`` (the pristine
+adapter — captured before the FIRST blackout — is reinstalled only when
+the last window closes, and never over an ``adapter_restart`` that
+replaced it mid-blackout).  The fuzzer (chaos/fuzz.py) generates exactly
+these overlapping same-kind schedules, so this is property-tested in
+tests/test_fault_injectors.py, not just convention.
 """
 
 from __future__ import annotations
@@ -194,16 +203,40 @@ def _default_node(pipe: "AutoscalingPipeline", spec: FaultSpec) -> str:
     return next(iter(pipe.cluster.nodes))
 
 
+def _node_fault_window(pipe: "AutoscalingPipeline", node_name: str) -> ClearFn:
+    """Overlap-safe node restoration, same shape as ``_wrap_fetch``: stacked
+    preempt/drain windows over one node each bump a per-node depth counter,
+    and ``restore_node`` runs only when the LAST window closes — naively
+    restoring on the first clear would resurrect a node another fault still
+    holds down (the fuzzer's overlapping schedules hit exactly this)."""
+    node = pipe.cluster.nodes[node_name]
+    node._fault_depth = getattr(node, "_fault_depth", 0) + 1
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        node._fault_depth -= 1
+        if node._fault_depth == 0:
+            pipe.cluster.restore_node(node_name)
+
+    return clear
+
+
 def _inject_node_preempt(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
     node = _default_node(pipe, spec)
+    clear = _node_fault_window(pipe, node)
     pipe.cluster.preempt_node(node)
-    return lambda: pipe.cluster.restore_node(node)
+    return clear
 
 
 def _inject_node_drain(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
     node = _default_node(pipe, spec)
+    clear = _node_fault_window(pipe, node)
     pipe.cluster.drain_node(node)
-    return lambda: pipe.cluster.restore_node(node)
+    return clear
 
 
 def _inject_pod_crash(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
@@ -222,6 +255,13 @@ def _inject_pod_crash(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
 def _inject_crashloop(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
     cluster = pipe.cluster
     deployment = spec.target or pipe.deployment.name
+    # per-deployment depth counter: two overlapping crashloop windows over
+    # the same deployment must not let the first clear stop the loop while
+    # the second window is still open
+    depths = getattr(cluster, "_crashloop_fault_depth", None)
+    if depths is None:
+        depths = cluster._crashloop_fault_depth = {}
+    depths[deployment] = depths.get(deployment, 0) + 1
     cluster.start_crashloop(deployment)
     # crash one running pod so the loop is immediately visible (its
     # replacement enters CrashLoopBackOff); without this the fault only
@@ -229,7 +269,18 @@ def _inject_crashloop(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
     running = cluster.running_pods(deployment)
     if running:
         cluster.kill_pod(running[0].name)
-    return lambda: cluster.stop_crashloop(deployment)
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        depths[deployment] -= 1
+        if depths[deployment] == 0:
+            cluster.stop_crashloop(deployment)
+
+    return clear
 
 
 class _BlackoutAdapter:
@@ -249,15 +300,30 @@ class _BlackoutAdapter:
 
 
 def _inject_adapter_blackout(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
-    real = pipe.hpa.adapter
+    # pipeline-level depth counter: a second overlapping blackout must not
+    # capture the first blackout's stand-in as the "real" adapter (clearing
+    # would then restore a blackout, blacking out the pipeline forever)
+    depth = getattr(pipe, "_adapter_blackout_depth", 0)
+    if depth == 0:
+        pipe._adapter_blackout_pristine = pipe.hpa.adapter
+    pipe._adapter_blackout_depth = depth + 1
     pipe.hpa.adapter = _BlackoutAdapter()
+    cleared = False
 
     def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        pipe._adapter_blackout_depth -= 1
         # an overlapping adapter_restart may have replaced the adapter while
         # the blackout was in force; only swap the real one back if the
-        # blackout stand-in is still installed
-        if isinstance(pipe.hpa.adapter, _BlackoutAdapter):
-            pipe.hpa.adapter = real
+        # blackout stand-in is still installed, and only when the last
+        # overlapping window closes
+        if pipe._adapter_blackout_depth == 0 and isinstance(
+            pipe.hpa.adapter, _BlackoutAdapter
+        ):
+            pipe.hpa.adapter = pipe._adapter_blackout_pristine
 
     return clear
 

@@ -48,6 +48,7 @@ DOMAINS = (
     "alert_state",
     "recovery_path",
     "concurrency",
+    "fuzz",
 )
 
 EXPORT_VERSION = 1
@@ -317,6 +318,31 @@ probe(
     "concurrency",
     "lockset_assert_armed",
     "race harness armed the instrumented lock over the inferred lockset",
+)
+
+# -- fuzz: the coverage-guided adversarial searcher's own loop joints
+# (chaos/fuzz.py) — the fuzzer both CONSUMES this map (novelty steering)
+# and is itself a probed decision path, so `simulate coverage --run fuzz`
+# proves the search machinery end to end.
+probe(
+    "fuzz",
+    "mutation_accepted",
+    "fuzzer kept a mutated case (novel coverage or higher fitness)",
+)
+probe(
+    "fuzz",
+    "mutation_rejected",
+    "fuzzer discarded a mutated case (nothing new, no fitness gain)",
+)
+probe(
+    "fuzz",
+    "minimizer_step",
+    "delta-debugging minimizer re-ran a reduced candidate schedule",
+)
+probe(
+    "fuzz",
+    "corpus_replay",
+    "a committed seed+schedule corpus artifact was replayed",
 )
 
 

@@ -194,6 +194,11 @@ COVERAGE_DOMAIN_FLOORS = {
     # the races run drives all five probes (serial + permuted schedules,
     # parallel + fallback branches, armed lockset); measured 1.00
     "concurrency": 0.80,
+    # the fuzz coverage session (chaos/fuzz.run_fuzz_coverage_session)
+    # drives accept, reject, minimize, AND replay deterministically;
+    # measured 1.00 — a loop edit that stops exercising a whole joint
+    # (e.g. the minimizer never running) trips this floor
+    "fuzz": 0.75,
 }
 
 # ---- race_sweep smoke (tools/tier1.sh, `simulate races`) -------------------
@@ -211,3 +216,42 @@ RACE_SWEEP_TICKS = 6
 #: at least this many probes never hit (measured 12) — zero would mean the
 #: gap list went dark and coverage stopped carrying information
 COVERAGE_MIN_NEVER_HIT = 1
+
+# ---- chaos_fuzz: the coverage-guided adversarial fuzzer (ISSUE 16) ----------
+
+#: mutation attempts for the tier-1 smoke (`simulate fuzz --budget 8 --seed
+#: 7` in tools/tier1.sh) — small enough to stay inside the tier-1 wall-time
+#: budget, large enough to exercise accept/reject and the novelty steering
+FUZZ_SMOKE_BUDGET = 8
+FUZZ_SMOKE_SEED = 7
+
+#: the bench rung's exploration budget; the rung runs it TWICE and requires
+#: the two result records to be bit-identical (the determinism gate the
+#: whole corpus/replay design rests on)
+FUZZ_RUNG_BUDGET = 8
+FUZZ_RUNG_SEED = 7
+
+#: the planted-bug acceptance gate: with the test-only --break-grace canary
+#: armed (eviction grace effectively infinite, so any preemption strands
+#: Terminating pods), the fuzzer must FIND a failing schedule and minimize
+#: it within this many mutation attempts
+FUZZ_CANARY_BUDGET = 6
+FUZZ_CANARY_SEED = 7
+
+#: coverage-novelty floor per exploration budget: at least this many
+#: accepted mutations must each have contributed a previously-unseen probe
+#: across the rung's FUZZ_RUNG_BUDGET attempts (measured well above; a
+#: mutator that stopped diversifying fault kinds lands at 0-1)
+FUZZ_MIN_NOVEL_ACCEPTS = 2
+
+#: minimizer shrink ceiling: minimized faults / failing-schedule faults for
+#: the canary failure (the delta-debugger must actually delete schedule
+#: mass, not hand back the input)
+FUZZ_MAX_SHRINK_RATIO = 0.67
+
+#: the `coverage --run fuzz` session's campaign budget — pinned with its
+#: seed so the campaign both accepts AND rejects at least one mutation;
+#: the session then minimizes + replays chaos/fuzz.CANARY_CORE so the
+#: minimizer/replay probes are also hit deterministically
+FUZZ_COVERAGE_BUDGET = 4
+FUZZ_COVERAGE_SEED = 11

@@ -418,8 +418,8 @@ def render_slo_report(result: dict) -> str:
 
 
 #: the canned runs ``simulate coverage`` can collect under one map — the
-#: same five the coverage_floor bench rung unions (bench.py)
-COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo", "races")
+#: same six the coverage_floor bench rung unions (bench.py)
+COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo", "races", "fuzz")
 
 
 def run_coverage(run: str = "all", seed: int | None = None) -> dict:
@@ -429,6 +429,7 @@ def run_coverage(run: str = "all", seed: int | None = None) -> dict:
     the run label so same-seed exports are bit-identical and differently-
     labeled ones are not conflated."""
     from k8s_gpu_hpa_tpu.chaos.crunch import run_capacity_crunch
+    from k8s_gpu_hpa_tpu.chaos.fuzz import run_fuzz_coverage_session
     from k8s_gpu_hpa_tpu.chaos.storm import run_fault_storm
     from k8s_gpu_hpa_tpu.control.race_harness import run_race_sweep
     from k8s_gpu_hpa_tpu.control.scale_harness import run_recovery_drill
@@ -448,6 +449,11 @@ def run_coverage(run: str = "all", seed: int | None = None) -> dict:
                 run_slo_check()
             elif name == "races":
                 run_race_sweep(seed=0 if seed is None else seed)
+            elif name == "fuzz":
+                # the fuzz session's campaign seed/budget are pinned in
+                # perfgates (they guarantee all four fuzz:* probes fire);
+                # --seed varies the storm/races, not the fuzz campaign
+                run_fuzz_coverage_session()
     return cmap.export()
 
 
@@ -1162,6 +1168,58 @@ def main(args) -> int:
         print(render_race_report(result))
         return 0 if result["ok"] else 2
 
+    if args.scenario == "fuzz":
+        # coverage-guided adversarial search (chaos/fuzz.py): mutate fault
+        # schedules + traffic against the fixed fuzz harness, minimize any
+        # contract failure to a replayable seed+schedule artifact.  Exit
+        # codes: 0 = clean exploration (or the --break-grace canary found
+        # and minimized, which is the fuzzer WORKING); 1 = a genuine
+        # minimized failure (new corpus material — commit the artifact);
+        # 2 = a failure that does not reproduce or cannot be minimized,
+        # or a --replay that diverged from its recorded fingerprint.
+        from k8s_gpu_hpa_tpu import perfgates
+        from k8s_gpu_hpa_tpu.chaos.fuzz import (
+            render_fuzz_report,
+            replay_artifact,
+            run_fuzz,
+        )
+
+        replay = getattr(args, "replay", None)
+        if replay:
+            try:
+                result = replay_artifact(replay)
+            except (OSError, ValueError, KeyError) as e:
+                print(f"simulate fuzz --replay: {e}")
+                return 2
+            if result["ok"]:
+                print(
+                    f"scenario {result['name']}: reproduced bit-identically "
+                    f"({len(result['violations'])} recorded violation(s) "
+                    "fired again)"
+                )
+                return 0
+            print(f"scenario {result['name']}: DID NOT REPRODUCE")
+            print(f"  expected violations: {result['expected_violations']}")
+            print(f"  got violations:      {result['violations']}")
+            return 2
+
+        budget = getattr(args, "budget", None) or perfgates.FUZZ_SMOKE_BUDGET
+        seed = (
+            args.seed if args.seed is not None else perfgates.FUZZ_SMOKE_SEED
+        )
+        report = run_fuzz(
+            budget=budget,
+            seed=seed,
+            break_grace=getattr(args, "break_grace", False),
+            out_dir=getattr(args, "fuzz_out", None),
+        )
+        print(render_fuzz_report(report))
+        if not report["ok"]:
+            return 2
+        if report["failure"] is not None and not report["break_grace"]:
+            return 1
+        return 0
+
     if args.scenario == "history":
         # the flight recorder: multi-day diurnal run summarized from the
         # rollup tiers, with a mid-run TSDB crash+WAL-replay — exits
@@ -1341,6 +1399,7 @@ if __name__ == "__main__":
             "why",
             "coverage",
             "races",
+            "fuzz",
         ],
     )
     parser.add_argument(
@@ -1395,15 +1454,44 @@ if __name__ == "__main__":
         "--run",
         default=None,
         help="which canned run the 'coverage' scenario collects "
-        "(storm, crunch, drill, slo, races, or all; default all)",
+        "(storm, crunch, drill, slo, races, fuzz, or all; default all)",
     )
     parser.add_argument(
         "--seed",
         type=int,
         default=None,
         help="schedule-variant seed for the 'coverage' scenario's storm "
-        "(chaos/storm.py) and the 'races' schedule permutations; default "
-        "is the fixed canned timeline (races: seed 0)",
+        "(chaos/storm.py), the 'races' schedule permutations, and the "
+        "'fuzz' campaign; default is the fixed canned timeline "
+        "(races: seed 0, fuzz: perfgates.FUZZ_SMOKE_SEED)",
+    )
+    parser.add_argument(
+        "--budget",
+        type=int,
+        default=None,
+        help="fuzz: exploration cases the campaign runs "
+        "(default perfgates.FUZZ_SMOKE_BUDGET)",
+    )
+    parser.add_argument(
+        "--replay",
+        default=None,
+        metavar="SCENARIO_JSON",
+        help="fuzz: replay a committed corpus artifact (tests/scenarios/*) "
+        "instead of searching; exit 2 unless it reproduces bit-identically",
+    )
+    parser.add_argument(
+        "--break-grace",
+        action="store_true",
+        help="fuzz: arm the test-only canary that stretches the preemption "
+        "eviction grace to forever — proves the fuzzer can find and "
+        "minimize a real failure",
+    )
+    parser.add_argument(
+        "--fuzz-out",
+        default=None,
+        metavar="DIR",
+        help="fuzz: write the minimized failure's replayable artifact "
+        "under DIR (the corpus-commit workflow)",
     )
     parser.add_argument(
         "--schedules",

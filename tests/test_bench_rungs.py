@@ -323,6 +323,68 @@ def test_capacity_crunch_rung_gates_the_full_contract():
     assert result["ok"] is True
 
 
+def test_chaos_fuzz_rung_pins_keys_and_gate_logic(monkeypatch):
+    """The adversarial-fuzzing rung (chaos/fuzz.py): the driver parses these
+    keys verbatim — pin the record shape and the ok-conjunction with the
+    campaigns stubbed (the real canary find/minimize and bit-identity proofs
+    run in tests/test_fuzz.py; the rung re-proves them at full budget on
+    every unbudgeted bench run)."""
+    import bench as bench_mod
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.chaos import fuzz
+
+    def fake_run_fuzz(budget, seed, break_grace=False):
+        if break_grace:
+            return {
+                "novel_accepts": 3,
+                "failure": {
+                    "reproducible": True,
+                    "minimized": {"faults": [{}, {}], "traffic": {}},
+                    "shrink_ratio": 0.4,
+                },
+            }
+        return {"novel_accepts": perfgates.FUZZ_MIN_NOVEL_ACCEPTS}
+
+    monkeypatch.setattr(fuzz, "run_fuzz", fake_run_fuzz)
+    result = bench_mod.run_rung_chaos_fuzz()
+    assert set(result) == {
+        "mode",
+        "metric",
+        "budget",
+        "seed",
+        "bit_identical",
+        "novel_accepts",
+        "novel_accepts_min",
+        "canary_budget",
+        "canary_found",
+        "canary_minimized",
+        "canary_shrink_ratio",
+        "shrink_ratio_max",
+        "canary_minimized_faults",
+        "ok",
+    }
+    assert result["mode"] == "virtual"
+    assert result["budget"] == perfgates.FUZZ_RUNG_BUDGET
+    assert result["canary_budget"] == perfgates.FUZZ_CANARY_BUDGET
+    assert result["shrink_ratio_max"] == perfgates.FUZZ_MAX_SHRINK_RATIO
+    assert result["bit_identical"] is True
+    assert result["canary_found"] is True
+    assert result["canary_minimized"] is True
+    assert result["ok"] is True
+
+    # the gate is a genuine conjunction: a canary the fuzzer cannot find
+    # fails the rung even with determinism and novelty intact
+    def no_canary(budget, seed, break_grace=False):
+        if break_grace:
+            return {"novel_accepts": 0, "failure": None}
+        return {"novel_accepts": perfgates.FUZZ_MIN_NOVEL_ACCEPTS}
+
+    monkeypatch.setattr(fuzz, "run_fuzz", no_canary)
+    result = bench_mod.run_rung_chaos_fuzz()
+    assert result["canary_found"] is False
+    assert result["ok"] is False
+
+
 def test_coverage_floor_rung_gates_union_domains_and_gap_list():
     """The execution-coverage rung (obs/coverage.py): the four-scenario
     union must clear every declared floor AND still leave a non-empty

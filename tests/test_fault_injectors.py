@@ -177,6 +177,78 @@ def test_overlapping_scrape_faults_restore_pristine_fetch(tmp_path, close_order)
     assert target._fault_depth == 0
 
 
+#: the kinds whose clears gate on a shared resource (node, deployment loop,
+#: adapter slot) rather than a wrapped fetch — exactly the ones the fuzzer's
+#: overlapping same-kind schedules stress (chaos/fuzz.py emits these freely)
+_SAME_KIND_OVERLAP = {
+    "node_preempt": dict(duration=20.0, target="tpu-node-0"),
+    "node_drain": dict(duration=20.0, target="tpu-node-0"),
+    "crashloop": dict(duration=10.0),
+    "adapter_blackout": dict(duration=10.0),
+}
+
+
+def _fault_in_force(pipe, kind: str) -> bool:
+    if kind in ("node_preempt", "node_drain"):
+        return not pipe.cluster.nodes["tpu-node-0"].schedulable
+    if kind == "crashloop":
+        return "tpu-test" in pipe.cluster.crashlooping
+    if kind == "adapter_blackout":
+        return type(pipe.hpa.adapter).__name__ == "_BlackoutAdapter"
+    raise KeyError(kind)
+
+
+@pytest.mark.parametrize("close_order", ["fifo", "lifo"])
+@pytest.mark.parametrize("kind", sorted(_SAME_KIND_OVERLAP))
+def test_same_kind_overlap_clears_idempotently(tmp_path, kind, close_order):
+    """Two same-kind faults overlapping in time (fuzzer-shaped schedules
+    produce these constantly): the fault must stay in force until the LAST
+    window closes — whichever order the windows close in — and every clear
+    must be idempotent."""
+    clock, pipe, state = make_durable_pipeline(tmp_path)
+    spec_kwargs = _SAME_KIND_OVERLAP[kind]
+    clear_a = FAULT_KINDS[kind](pipe, FaultSpec(kind, 0.0, **spec_kwargs))
+    clock.advance(5.0)
+    clear_b = FAULT_KINDS[kind](pipe, FaultSpec(kind, 0.0, **spec_kwargs))
+    assert _fault_in_force(pipe, kind)
+    first, second = (
+        (clear_a, clear_b) if close_order == "fifo" else (clear_b, clear_a)
+    )
+    first()
+    first()  # idempotent: must not burn the other window's reference
+    assert _fault_in_force(pipe, kind), (
+        f"{kind}/{close_order}: first clear lifted a fault whose second "
+        "window was still open"
+    )
+    second()
+    second()
+    assert not _fault_in_force(pipe, kind), (
+        f"{kind}/{close_order}: fault still in force after the last "
+        "window closed"
+    )
+    # the pipeline recovers once the real clear lands
+    clock.advance(120.0)
+    assert pipe.running() >= 1
+
+
+def test_overlapping_node_preempt_and_drain_restore_once(tmp_path):
+    """Mixed node kinds over ONE node share the depth counter: the node
+    comes back only when the last of the stacked windows closes."""
+    clock, pipe, state = make_durable_pipeline(tmp_path)
+    clear_preempt = FAULT_KINDS["node_preempt"](
+        pipe, FaultSpec("node_preempt", 0.0, 20.0, target="tpu-node-0")
+    )
+    clear_drain = FAULT_KINDS["node_drain"](
+        pipe, FaultSpec("node_drain", 0.0, 40.0, target="tpu-node-0")
+    )
+    clear_preempt()
+    node = pipe.cluster.nodes["tpu-node-0"]
+    assert not node.schedulable, "drain window still open"
+    clear_drain()
+    assert node.schedulable and node.ready
+    assert node._fault_depth == 0
+
+
 def test_overlapping_adapter_blackout_and_restart(tmp_path):
     """An adapter_restart landing INSIDE a blackout window: the blackout's
     clear must not resurrect the torn-down adapter it captured at inject."""

@@ -1,0 +1,446 @@
+"""Coverage-guided adversarial chaos fuzzing (chaos/fuzz.py, ISSUE 16).
+
+The load-bearing clauses, in rough order of how much the design rests on
+them:
+
+- **determinism** — the same seeded campaign run twice is bit-identical
+  (canonical JSON compared), and one case run twice fingerprints
+  identically; without this, nothing downstream (minimization, the corpus)
+  means anything;
+- **the planted canary** — with ``break_grace`` armed the fuzzer must FIND
+  a failing schedule within the pinned ``perfgates.FUZZ_CANARY_BUDGET``,
+  prove it reproduces, minimize it, and export a replayable artifact;
+- **the minimizer golden** — a hand-built 8-fault schedule with a known
+  2-fault failing core (a scrape_blackout overlapping a tenant_spike,
+  checked by a synthetic predicate so the test is sim-free and exact)
+  minimizes to precisely that core, bit-identically across two runs;
+- **the corpus** — every committed ``tests/scenarios/*.json`` replays
+  green, and a doctored fingerprint exits 2 through the real CLI;
+- **registry sync** — the mutation pool equals ``FAULT_KINDS`` (the lint
+  enforces this statically; here the live registries).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from pathlib import Path
+
+import pytest
+
+from k8s_gpu_hpa_tpu import perfgates
+from k8s_gpu_hpa_tpu.__main__ import main as umbrella_main
+from k8s_gpu_hpa_tpu.chaos import fuzz
+from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS
+from k8s_gpu_hpa_tpu.control.fuzz_harness import (
+    DEFAULT_TRAFFIC,
+    FUZZ_MAX_AT_S,
+    FUZZ_MAX_DURATION_S,
+    FUZZ_MAX_FAULTS,
+    FUZZ_TRAFFIC_MAX,
+    FUZZ_TRAFFIC_MIN,
+    run_fuzz_case,
+)
+from k8s_gpu_hpa_tpu.obs import coverage
+
+SCENARIOS_DIR = Path(__file__).resolve().parent / "scenarios"
+
+
+# ---- registry sync ----------------------------------------------------------
+
+
+def test_mutation_pool_covers_the_whole_registry():
+    """Every registered fault kind is reachable by the search, and the pool
+    names nothing the registry dropped (tools/lint_faults.py re-checks this
+    statically from the literal tuple; here the live objects)."""
+    assert set(fuzz.MUTATION_FAULT_KINDS) == set(FAULT_KINDS)
+
+
+def test_fuzz_is_a_registered_coverage_run():
+    from k8s_gpu_hpa_tpu.simulate import COVERAGE_RUN_NAMES
+
+    assert "fuzz" in COVERAGE_RUN_NAMES
+    assert "fuzz" in coverage.DOMAINS
+    assert "fuzz" in perfgates.COVERAGE_DOMAIN_FLOORS
+    assert {p for p in coverage.probe_ids() if p.startswith("fuzz:")} == {
+        "fuzz:mutation_accepted",
+        "fuzz:mutation_rejected",
+        "fuzz:minimizer_step",
+        "fuzz:corpus_replay",
+    }
+
+
+# ---- pure helpers -----------------------------------------------------------
+
+
+def test_spec_dict_round_trip():
+    d = {
+        "kind": "tenant_spike",
+        "at": 30.0,
+        "duration": 60.0,
+        "target": "tpu-batch",
+        "params": {"add": 80.0},
+    }
+    assert fuzz.spec_to_dict(fuzz.spec_from_dict(d)) == d
+
+
+def test_violation_signature_classifies_known_clauses():
+    sig = fuzz.violation_signature(
+        [
+            "tpu-batch: did not converge (0/1 running, 0 pending, 1 terminating)",
+            "not every fault recovered",
+            "tpu-prod: starved 400s past its 300s budget",
+            "something the classifier has never seen",
+        ]
+    )
+    assert sig == ("convergence", "other", "recovery", "starvation")
+
+
+def test_mutations_respect_schedule_bounds():
+    """200 mutation steps from one rng: every produced case stays inside the
+    declared schedule-shape bounds the replayer honours."""
+    import random
+
+    rng = random.Random(5)
+    case = {"faults": [], "traffic": dict(DEFAULT_TRAFFIC)}
+    for _ in range(200):
+        case = fuzz.mutate_case(case, rng, [])
+        assert len(case["faults"]) <= FUZZ_MAX_FAULTS
+        for f in case["faults"]:
+            assert f["kind"] in FAULT_KINDS
+            assert 0.0 <= f["at"] <= FUZZ_MAX_AT_S
+            assert 0.0 <= f["duration"] <= FUZZ_MAX_DURATION_S
+        assert set(case["traffic"]) == set(DEFAULT_TRAFFIC)
+        for load in case["traffic"].values():
+            assert FUZZ_TRAFFIC_MIN <= load <= FUZZ_TRAFFIC_MAX
+
+
+# ---- minimizer golden (sim-free: synthetic predicate, exact expectations) ---
+
+#: 8 faults, of which exactly two form the failing core: the
+#: scrape_blackout (100..160) overlapping the tenant_spike (120..160)
+_GOLDEN_SCHEDULE = [
+    {"kind": "exporter_outage", "at": 10.0, "duration": 30.0, "target": None, "params": {}},
+    {"kind": "node_drain", "at": 40.0, "duration": 50.0, "target": "fuzz-node-1", "params": {}},
+    {"kind": "scrape_blackout", "at": 100.0, "duration": 60.0, "target": None, "params": {}},
+    {"kind": "pod_crash", "at": 110.0, "duration": 0.0, "target": None, "params": {}},
+    {"kind": "tenant_spike", "at": 120.0, "duration": 40.0, "target": "tpu-batch", "params": {"add": 80.0}},
+    {"kind": "slow_scrape", "at": 200.0, "duration": 45.0, "target": None, "params": {}},
+    {"kind": "hpa_restart", "at": 260.0, "duration": 0.0, "target": None, "params": {}},
+    {"kind": "wal_truncate", "at": 300.0, "duration": 0.0, "target": None, "params": {"records": 4}},
+]
+
+
+def _blackout_overlaps_spike(faults: list[dict]) -> bool:
+    def overlap(a: dict, b: dict) -> bool:
+        return (
+            a["at"] < b["at"] + b["duration"]
+            and b["at"] < a["at"] + a["duration"]
+        )
+
+    return any(
+        overlap(a, b)
+        for a in faults
+        if a["kind"] == "scrape_blackout"
+        for b in faults
+        if b["kind"] == "tenant_spike"
+    )
+
+
+def test_minimizer_golden_8_fault_schedule_to_2_fault_core():
+    """The golden: ddmin drops the six decoys, the shrink phase halves the
+    core durations to the smallest still-overlapping windows, the shift
+    phase can move nothing (pulling either start toward 0 breaks the
+    overlap) — exact output pinned, bit-identical across two runs."""
+    first, runs_1 = fuzz.minimize_schedule(
+        copy.deepcopy(_GOLDEN_SCHEDULE), _blackout_overlaps_spike
+    )
+    second, runs_2 = fuzz.minimize_schedule(
+        copy.deepcopy(_GOLDEN_SCHEDULE), _blackout_overlaps_spike
+    )
+    assert first == [
+        {
+            "kind": "scrape_blackout",
+            "at": 100.0,
+            "duration": 30.0,
+            "target": None,
+            "params": {},
+        },
+        {
+            "kind": "tenant_spike",
+            "at": 120.0,
+            "duration": 5.0,
+            "target": "tpu-batch",
+            "params": {"add": 80.0},
+        },
+    ]
+    # rng-free by construction: the second run is the first, bit for bit
+    assert second == first and runs_2 == runs_1
+
+
+def test_minimizer_respects_the_run_budget():
+    calls = []
+
+    def never_shrinks(faults: list[dict]) -> bool:
+        calls.append(1)
+        return False  # nothing but the full schedule fails
+
+    minimized, runs = fuzz.minimize_schedule(
+        copy.deepcopy(_GOLDEN_SCHEDULE), never_shrinks, max_runs=7
+    )
+    assert minimized == _GOLDEN_SCHEDULE
+    assert runs == len(calls) == 7
+
+
+# ---- case-runner determinism ------------------------------------------------
+
+
+def test_clean_case_passes_contract_and_fingerprints_identically():
+    """A fault-free case must pass the contract clean (so every violation
+    the fuzzer surfaces is schedule-caused), and two identical runs must
+    fingerprint identically (what corpus replay rests on)."""
+    first = run_fuzz_case([])
+    second = run_fuzz_case([])
+    assert first["violations"] == []
+    assert first["ok"] is True
+    assert first["fingerprint"] == second["fingerprint"]
+
+
+# ---- the planted canary (one campaign shared across assertions) -------------
+
+
+@pytest.fixture(scope="module")
+def canary_report(tmp_path_factory):
+    out = tmp_path_factory.mktemp("fuzz-corpus")
+    report = fuzz.run_fuzz(
+        budget=perfgates.FUZZ_CANARY_BUDGET,
+        seed=perfgates.FUZZ_CANARY_SEED,
+        break_grace=True,
+        out_dir=out,
+    )
+    return report
+
+
+def test_canary_found_within_pinned_budget(canary_report):
+    failure = canary_report["failure"]
+    assert failure is not None, (
+        f"--break-grace canary not found within "
+        f"{perfgates.FUZZ_CANARY_BUDGET} cases"
+    )
+    assert failure["case_index"] < perfgates.FUZZ_CANARY_BUDGET
+    assert failure["reproducible"] is True
+    assert "convergence" in failure["signature"]
+    assert canary_report["ok"] is True
+
+
+def test_canary_minimizes_to_a_small_core(canary_report):
+    failure = canary_report["failure"]
+    minimized = failure["minimized"]
+    assert minimized is not None, "canary failure did not minimize"
+    assert (
+        failure["shrink_ratio"] <= perfgates.FUZZ_MAX_SHRINK_RATIO
+        or len(minimized["faults"]) <= 2
+    )
+    # the known core: a prod spike while provisioning is down forces the
+    # preemption whose victim --break-grace strands in Terminating
+    kinds = sorted(f["kind"] for f in minimized["faults"])
+    assert "tenant_spike" in kinds
+
+
+def test_canary_artifact_written_and_replays_green(canary_report):
+    failure = canary_report["failure"]
+    path = failure["artifact_path"]
+    assert path is not None and Path(path).exists()
+    replay = fuzz.replay_artifact(path)
+    assert replay["ok"] is True, replay
+
+
+# ---- campaign determinism ---------------------------------------------------
+
+
+def test_same_seed_campaigns_are_bit_identical():
+    """The acceptance clause: same seed ⇒ bit-identical fuzz run.  Budget 4
+    keeps this cheap; the bench rung re-proves it at FUZZ_RUNG_BUDGET."""
+    canon = lambda r: json.dumps(r, sort_keys=True, separators=(",", ":"))  # noqa: E731
+    first = fuzz.run_fuzz(budget=4, seed=3)
+    second = fuzz.run_fuzz(budget=4, seed=3)
+    assert canon(first) == canon(second)
+
+
+# ---- the committed corpus ---------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "scenario",
+    sorted(SCENARIOS_DIR.glob("*.json")),
+    ids=lambda p: p.stem,
+)
+def test_committed_scenario_replays_green(scenario):
+    """Every artifact under tests/scenarios/ must reproduce its recorded
+    fingerprint bit-for-bit — a minimized fuzz failure is only a regression
+    test while it still fails the same way (tier1.sh re-runs these through
+    the CLI; this is the in-suite twin)."""
+    replay = fuzz.replay_artifact(scenario)
+    assert replay["fingerprint_match"] is True, replay
+    assert replay["violations_match"] is True
+    assert replay["ok"] is True
+
+
+def test_committed_corpus_is_not_empty():
+    assert sorted(SCENARIOS_DIR.glob("*.json")), "regression corpus is empty"
+
+
+# ---- CLI exit codes ---------------------------------------------------------
+
+
+def test_cli_replay_green_scenario_exits_0(capsys):
+    scenario = sorted(SCENARIOS_DIR.glob("*.json"))[0]
+    rc = umbrella_main(
+        ["simulate", "--scenario", "fuzz", "--replay", str(scenario)]
+    )
+    assert rc == 0
+    assert "reproduced bit-identically" in capsys.readouterr().out
+
+
+def test_cli_replay_doctored_fingerprint_exits_2(tmp_path, capsys):
+    """The non-reproducing path, through the real CLI: an artifact whose
+    recorded fingerprint no longer matches what the sim produces is a dead
+    regression test and must fail loudly, not replay vacuously."""
+    artifact = json.loads(
+        sorted(SCENARIOS_DIR.glob("*.json"))[0].read_text()
+    )
+    artifact["expect"]["fingerprint"] = artifact["expect"]["fingerprint"][:-2] + '"'
+    doctored = tmp_path / "doctored.json"
+    doctored.write_text(json.dumps(artifact))
+    rc = umbrella_main(
+        ["simulate", "--scenario", "fuzz", "--replay", str(doctored)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "DID NOT REPRODUCE" in out
+
+
+def test_cli_replay_missing_file_exits_2(tmp_path, capsys):
+    rc = umbrella_main(
+        [
+            "simulate",
+            "--scenario",
+            "fuzz",
+            "--replay",
+            str(tmp_path / "nope.json"),
+        ]
+    )
+    assert rc == 2
+    assert "simulate fuzz --replay" in capsys.readouterr().out
+
+
+def _campaign_report(**overrides) -> dict:
+    report = {
+        "scenario": "fuzz",
+        "mode": "virtual",
+        "budget": 8,
+        "seed": 7,
+        "break_grace": False,
+        "cases_run": 8,
+        "accepted": 5,
+        "rejected": 3,
+        "novel_accepts": 4,
+        "best_score": 12.0,
+        "coverage_probes_hit": 30,
+        "failure": None,
+        "ok": True,
+    }
+    report.update(overrides)
+    return report
+
+
+def _failure_record(**overrides) -> dict:
+    record = {
+        "case_index": 2,
+        "case": {"faults": _GOLDEN_SCHEDULE[:4], "traffic": dict(DEFAULT_TRAFFIC)},
+        "violations": ["tpu-batch: did not converge (0/1 running, 0 pending, 1 terminating)"],
+        "signature": ["convergence"],
+        "score": 112.0,
+        "reproducible": True,
+        "minimized": {
+            "faults": _GOLDEN_SCHEDULE[:1],
+            "traffic": dict(DEFAULT_TRAFFIC),
+        },
+        "minimizer_runs": 12,
+        "shrink_ratio": 0.25,
+        "artifact": None,
+        "artifact_path": None,
+    }
+    record.update(overrides)
+    return record
+
+
+@pytest.mark.parametrize(
+    "report,expected_rc",
+    [
+        # clean exploration: nothing found, exit 0
+        (_campaign_report(), 0),
+        # genuine minimized failure: new corpus material, exit 1
+        (
+            _campaign_report(failure=_failure_record(), ok=True),
+            1,
+        ),
+        # canary armed and found+minimized: the fuzzer WORKING, exit 0
+        (
+            _campaign_report(
+                break_grace=True, failure=_failure_record(), ok=True
+            ),
+            0,
+        ),
+        # non-reproducing failure: exit 2
+        (
+            _campaign_report(
+                failure=_failure_record(
+                    reproducible=False, minimized=None, shrink_ratio=None
+                ),
+                ok=False,
+            ),
+            2,
+        ),
+        # unminimizable failure: exit 2
+        (
+            _campaign_report(
+                failure=_failure_record(minimized=None, shrink_ratio=None),
+                ok=False,
+            ),
+            2,
+        ),
+    ],
+    ids=["clean", "genuine", "canary", "non-reproducing", "unminimizable"],
+)
+def test_cli_campaign_exit_codes(monkeypatch, capsys, report, expected_rc):
+    """The full exit-code contract through the real dispatch, with the
+    campaign stubbed (the report shapes are the ones run_fuzz emits; the
+    expensive real-campaign paths are proven above and in the bench rung)."""
+    monkeypatch.setattr(fuzz, "run_fuzz", lambda **kw: dict(report))
+    rc = umbrella_main(["simulate", "--scenario", "fuzz", "--budget", "8"])
+    capsys.readouterr()
+    assert rc == expected_rc
+
+
+# ---- coverage session -------------------------------------------------------
+
+
+def test_fuzz_coverage_session_drives_all_fuzz_probes():
+    """`simulate coverage --run fuzz` must light all four fuzz:* probes —
+    accept and reject from the pinned campaign, minimizer steps and a
+    corpus replay from the canned canary core — and clear the declared
+    per-domain floor."""
+    with coverage.collect("fuzz-session") as cmap:
+        report = fuzz.run_fuzz_coverage_session()
+    assert report["coverage_session"]["replay_ok"] is True
+    hit = {p for p, c in cmap.counts.items() if c > 0}
+    for probe_id in (
+        "fuzz:mutation_accepted",
+        "fuzz:mutation_rejected",
+        "fuzz:minimizer_step",
+        "fuzz:corpus_replay",
+    ):
+        assert probe_id in hit, f"{probe_id} never fired"
+    summary = cmap.domain_summary("fuzz")
+    assert summary["ratio"] >= perfgates.COVERAGE_DOMAIN_FLOORS["fuzz"]

@@ -892,7 +892,7 @@ def build_dashboard() -> dict:
             ],
             "Hit ratio per probe domain (hpa_condition, scheduler_branch, "
             "planner_path, fault_kind, alert_state, recovery_path, "
-            "concurrency).  The "
+            "concurrency, fuzz).  The "
             "red line marks the union floor the coverage_floor rung gates "
             "on; one domain collapsing while the rest hold means a scenario "
             "edit stopped exercising that subsystem.",

@@ -15,7 +15,11 @@ silently rot in three ways this lint closes:
   tests/test_fault_injectors.py, so it is excluded from the auto-covering
   inject/clear-twice/survive parametrization (a bare mention elsewhere in
   tests/ would satisfy the previous check while the injector itself stays
-  unexercised).
+  unexercised);
+- **not fuzzed**: the kind is missing from the fuzzer's mutation pool
+  (chaos/fuzz.py MUTATION_FAULT_KINDS), so the adversarial search can
+  never schedule it — a fault kind the fuzzer cannot reach is exempt from
+  the one machinery built to find its worst-case timing.
 
 Usage:
     python tools/lint_faults.py
@@ -32,6 +36,7 @@ sys.path.insert(0, str(REPO))
 
 import k8s_gpu_hpa_tpu.chaos.faults as faults_mod  # noqa: E402
 from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS  # noqa: E402
+from k8s_gpu_hpa_tpu.chaos.fuzz import MUTATION_FAULT_KINDS  # noqa: E402
 
 
 def _natural_spec_kinds(injector_test: Path) -> set[str]:
@@ -85,6 +90,19 @@ def lint_fault_kinds(tests_dir: Path | None = None) -> list[str]:
                 f"{kind}: no NATURAL_SPECS row in tests/test_fault_injectors.py "
                 "— excluded from the auto-covering injector parametrization"
             )
+        if kind not in MUTATION_FAULT_KINDS:
+            errors.append(
+                f"{kind}: missing from the fuzzer's mutation pool "
+                "(chaos/fuzz.py MUTATION_FAULT_KINDS) — the adversarial "
+                "search can never schedule it"
+            )
+    # the pool must also not name kinds the registry dropped (a stale pool
+    # entry would make the fuzzer emit specs FaultSpec refuses to validate)
+    for kind in sorted(set(MUTATION_FAULT_KINDS) - set(FAULT_KINDS)):
+        errors.append(
+            f"{kind}: in the fuzzer's mutation pool but not in FAULT_KINDS "
+            "— stale pool entry"
+        )
     return errors
 
 
@@ -99,7 +117,8 @@ def main(argv: list[str]) -> int:
         return 1
     print(
         f"lint_faults ok: {len(FAULT_KINDS)} fault kinds all have an "
-        "injector, a docstring row, and test coverage"
+        "injector, a docstring row, test coverage, and a fuzzer "
+        "mutation-pool entry"
     )
     return 0
 

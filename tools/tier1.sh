@@ -52,4 +52,17 @@ python -m k8s_gpu_hpa_tpu.simulate coverage --run drill || exit 1
 # violation (control/race_harness.py; the dynamic half of the concurrency
 # passes above)
 python -m k8s_gpu_hpa_tpu.simulate races || exit 1
+# fuzz smoke: a pinned seeded exploration campaign of the coverage-guided
+# adversarial fuzzer (chaos/fuzz.py) — exit 0 means the campaign ran clean
+# (no genuine contract failure, nothing non-reproducing); the canary
+# find/minimize proof and the bit-identity gate run in bench.py's
+# chaos_fuzz rung and tests/test_fuzz.py
+python -m k8s_gpu_hpa_tpu.simulate fuzz --budget 8 --seed 7 || exit 1
+# corpus replay: every committed scenario under tests/scenarios/ must
+# reproduce its recorded outcome fingerprint bit-for-bit — a minimized
+# fuzz failure is only a regression test if it still fails the same way
+for scenario in tests/scenarios/*.json; do
+  [ -e "$scenario" ] || continue
+  python -m k8s_gpu_hpa_tpu.simulate fuzz --replay "$scenario" || exit 1
+done
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
