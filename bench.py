@@ -1863,6 +1863,83 @@ def run_rung_chaos_fuzz() -> dict:
     }
 
 
+def run_rung_profile_bench() -> dict:
+    """Continuous-profiling rung (obs/profile.py): the three guarantees the
+    cost-attribution plane rests on, each gated by perfgates PROFILE_*:
+
+    - **attribution** — the scale run must attribute at least
+      PROFILE_MIN_ATTRIBUTION of its own measured (gc-disabled) wall
+      window to named stages, i.e. the "unattributed" bucket of the time
+      the sim_scale rungs gate on stays small;
+    - **determinism** — two same-seed storm runs must produce
+      bit-identical canonical (structural) exports, or committed profile
+      baselines couldn't gate anything;
+    - **canary** — a planted PROFILE_CANARY_PLANT_S-per-call slowdown on
+      PROFILE_CANARY_STAGE must trip the ``--diff`` share gate against
+      the clean run (the regression gate provably catches a real
+      hot-spot shift).
+
+    The per-stage breakdown rides in the record, so the ROADMAP item-3
+    rewrite lands with a before/after flame diff in the bench trajectory.
+    Wall-clock measured (real time), structure virtual-deterministic."""
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.control.profile_harness import run_profile
+    from k8s_gpu_hpa_tpu.obs import profile
+
+    # full sim_scale shape at TIME_SCALE 1 (the shape the ≥90% gate is
+    # specified at), the CI smoke shape otherwise
+    smoke = TIME_SCALE != 1.0
+    scale = run_profile("scale", smoke=smoke)[0]
+
+    first = run_profile("storm", seed=0)[0]
+    second = run_profile("storm", seed=0)[0]
+    bit_identical = first["canonical"] == second["canonical"]
+
+    planted = run_profile(
+        "storm",
+        seed=0,
+        plant={perfgates.PROFILE_CANARY_STAGE: perfgates.PROFILE_CANARY_PLANT_S},
+    )[0]
+    canary_diff = profile.diff_exports(first["timed"], planted["timed"])
+    canary_caught = canary_diff["regression"]
+    clean_diff = profile.diff_exports(first["timed"], second["timed"])
+
+    rollup = profile.stage_rollup(scale["timed"])
+    return {
+        "mode": "measured",
+        "metric": "stage attribution + export determinism + diff canary",
+        "scale_targets": (
+            perfgates.PROFILE_SCALE_SMOKE_TARGETS
+            if smoke
+            else perfgates.PROFILE_SCALE_TARGETS
+        ),
+        "scale_wall_s": scale["wall_s"],
+        "attribution": scale["attribution"],
+        "attribution_floor": perfgates.PROFILE_MIN_ATTRIBUTION,
+        "stages": {
+            sid: {
+                "calls": agg["calls"],
+                "self_s": agg["self_s"],
+                "cum_s": agg["cum_s"],
+            }
+            for sid, agg in sorted(rollup.items())
+        },
+        "open_spans": scale["open_spans"],
+        "bit_identical": bit_identical,
+        "canary_stage": perfgates.PROFILE_CANARY_STAGE,
+        "canary_plant_s": perfgates.PROFILE_CANARY_PLANT_S,
+        "canary_caught": canary_caught,
+        "clean_diff_regression": clean_diff["regression"],
+        "ok": (
+            scale["attribution_ok"]
+            and not scale["open_spans"]
+            and bit_identical
+            and canary_caught
+            and not clean_diff["regression"]
+        ),
+    }
+
+
 def run_rung_query_bench() -> dict:
     """Query-engine rung (metrics/planner.py + scale_harness): the fleet
     aggregate rule basket evaluated naive (logical ``Expr.evaluate``) and
@@ -2438,6 +2515,7 @@ def main() -> None:
             ("capacity_crunch", run_rung_capacity_crunch),
             ("coverage_floor", run_rung_coverage_floor),
             ("chaos_fuzz", run_rung_chaos_fuzz),
+            ("profile_bench", run_rung_profile_bench),
         ):
             log(f"rung {name}:")
             # chaos_fuzz is the one virtual rung whose WALL cost is minutes

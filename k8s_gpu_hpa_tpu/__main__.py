@@ -212,6 +212,7 @@ def main(argv: list[str] | None = None) -> int:
             "coverage",
             "races",
             "fuzz",
+            "profile",
         ],
         default="spike",
     )
@@ -231,8 +232,10 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument("--pod-start", type=float, default=12.0)
     sim.add_argument(
         "--trace-out",
-        default="trace.jsonl",
-        help="JSONL span export path for --scenario trace",
+        default=None,
+        help="JSONL span export path for --scenario trace (default "
+        "trace.jsonl); for --scenario profile, write the Chrome "
+        "trace_event JSON here (only when given)",
     )
     sim.add_argument(
         "--saturated-pct",
@@ -273,7 +276,9 @@ def main(argv: list[str] | None = None) -> int:
         "--run",
         default=None,
         help="which canned run --scenario coverage collects "
-        "(storm, crunch, drill, slo, races, fuzz, or all; default all)",
+        "(storm, crunch, drill, slo, races, fuzz, profile, or all; "
+        "default all) or --scenario profile measures "
+        "(storm, crunch, scale, or all; default storm)",
     )
     sim.add_argument(
         "--seed",
@@ -327,14 +332,36 @@ def main(argv: list[str] | None = None) -> int:
         dest="json_out",
         default=None,
         metavar="PATH",
-        help="write --scenario coverage's canonical export to PATH",
+        help="write --scenario coverage's canonical export or --scenario "
+        "profile's timed export to PATH",
     )
     sim.add_argument(
         "--diff",
-        nargs=2,
+        nargs="+",
         default=None,
-        metavar=("BASELINE", "CANDIDATE"),
-        help="diff two coverage --json exports; exit 2 on any lost probe",
+        metavar="EXPORT",
+        help="coverage: diff two --json exports (exit 2 on any lost "
+        "probe); profile: two paths diff offline, one path runs then "
+        "diffs this run against the baseline (exit 2 on regression)",
+    )
+    sim.add_argument(
+        "--flame-out",
+        default=None,
+        metavar="PATH",
+        help="profile: write the collapsed-stack (flamegraph.pl) "
+        "rendering to PATH",
+    )
+    sim.add_argument(
+        "--plant",
+        default=None,
+        metavar="STAGE=SECONDS",
+        help="profile: add artificial SECONDS per call of STAGE in the "
+        "accounting (regression canary; no real sleep)",
+    )
+    sim.add_argument(
+        "--smoke",
+        action="store_true",
+        help="profile: shrink the 'scale' run to the CI smoke shape",
     )
     sim.add_argument(
         "--floor",

@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import profile
 
 
 @dataclass(frozen=True)
@@ -109,16 +110,17 @@ class CustomMetricsAdapter:
     def _vector(self, series: str, matchers: dict[str, str] | None = None):
         """One instant read — planned when a planner is wired, the plain
         ``instant_vector`` surface otherwise (bit-identical either way)."""
-        if self.planner is None:
-            return self.db.instant_vector(series, matchers)
-        key = (series, tuple(sorted((matchers or {}).items())))
-        plan = self._plan_cache.get(key)
-        if plan is None:
-            from k8s_gpu_hpa_tpu.metrics.rules import Select
+        with profile.stage("adapter:query"):
+            if self.planner is None:
+                return self.db.instant_vector(series, matchers)
+            key = (series, tuple(sorted((matchers or {}).items())))
+            plan = self._plan_cache.get(key)
+            if plan is None:
+                from k8s_gpu_hpa_tpu.metrics.rules import Select
 
-            plan = self.planner.plan(Select(series, dict(matchers or {})))
-            self._plan_cache[key] = plan
-        return plan.evaluate(self.db)
+                plan = self.planner.plan(Select(series, dict(matchers or {})))
+                self._plan_cache[key] = plan
+            return plan.evaluate(self.db)
 
     def _traced(self, api: str, metric: str, query, found):
         """Run ``query`` under an ``adapter_query`` span whose links are the

@@ -45,7 +45,7 @@ from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimNode, SimPod
 from k8s_gpu_hpa_tpu.metrics.exposition import encode_text
-from k8s_gpu_hpa_tpu.obs import coverage
+from k8s_gpu_hpa_tpu.obs import coverage, profile
 from k8s_gpu_hpa_tpu.metrics.schema import MetricFamily
 
 # ---- pool self-metric names (dashboard / test_manifests contract) ----------
@@ -472,28 +472,29 @@ class CapacityScheduler:
     def try_place(self, pod: SimPod) -> bool:
         """One placement attempt (the ``_try_start`` hook).  True iff the pod
         bound to a node; False leaves it Pending on the cluster's requeue."""
-        nodes = self._schedulable_nodes()
-        budget = {n.name: len(n.free_chips()) for n in nodes}
-        for other in self._pending_order():
-            if other.name == pod.name:
-                for node in nodes:
-                    if budget[node.name] >= pod.chips_requested and (
-                        self.cluster.bind_pod(pod, node)
-                    ):
-                        self._record_admission(pod)
-                        return True
-                break
-            for name in budget:
-                if budget[name] >= other.chips_requested:
-                    budget[name] -= other.chips_requested
+        with profile.stage("capacity:try_place"):
+            nodes = self._schedulable_nodes()
+            budget = {n.name: len(n.free_chips()) for n in nodes}
+            for other in self._pending_order():
+                if other.name == pod.name:
+                    for node in nodes:
+                        if budget[node.name] >= pod.chips_requested and (
+                            self.cluster.bind_pod(pod, node)
+                        ):
+                            self._record_admission(pod)
+                            return True
                     break
-        self._note_pending(pod)
-        if self._fair_share_gate(pod):
+                for name in budget:
+                    if budget[name] >= other.chips_requested:
+                        budget[name] -= other.chips_requested
+                        break
+            self._note_pending(pod)
+            if self._fair_share_gate(pod):
+                return False
+            self._maybe_preempt(pod)
+            if self.autoscaler is not None:
+                self.autoscaler.request()
             return False
-        self._maybe_preempt(pod)
-        if self.autoscaler is not None:
-            self.autoscaler.request()
-        return False
 
     def _note_pending(self, pod: SimPod) -> None:
         if pod.name in self.pending_since:

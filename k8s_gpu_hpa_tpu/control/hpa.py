@@ -27,7 +27,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
 from k8s_gpu_hpa_tpu.control.adapter import CustomMetricsAdapter, ObjectReference
-from k8s_gpu_hpa_tpu.obs import coverage
+from k8s_gpu_hpa_tpu.obs import coverage, profile
 from k8s_gpu_hpa_tpu.utils.clock import Clock
 
 
@@ -646,6 +646,10 @@ class HPAController:
         that collects the adapter_query spans it triggered (tracer scope) and,
         when replicas change, is followed by a ``scale_event`` span — the root
         every lineage walk starts from."""
+        with profile.stage("hpa:sync"):
+            return self._sync_once_impl()
+
+    def _sync_once_impl(self) -> HPAStatus:
         if self.tracer is None and self.selfmetrics is None:
             status = self._sync_inner()
             self._save_checkpoint()

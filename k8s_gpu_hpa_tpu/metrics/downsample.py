@@ -44,6 +44,7 @@ from k8s_gpu_hpa_tpu.metrics.gorilla import (
     decode as gorilla_decode,
     summarize_values,
 )
+from k8s_gpu_hpa_tpu.obs import profile
 
 #: rollup row columns, in storage order (``RollupChunk.val_blobs`` /
 #: ``_TierState.encs`` are parallel to this)
@@ -333,6 +334,10 @@ class Downsampler:
         """Feed one sealed raw chunk's points into every tier accumulator.
         Decodes directly (aged chunks are cold; caching them would evict
         hot query decodes for data read exactly once)."""
+        with profile.stage("downsample:compact"):
+            self._ingest_chunk(roll, chunk)
+
+    def _ingest_chunk(self, roll: SeriesRollups, chunk) -> None:
         ts_arr, val_arr = chunk.arrays()
         ts_list = ts_arr.tolist()
         val_list = val_arr.tolist()

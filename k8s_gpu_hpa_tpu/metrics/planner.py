@@ -44,7 +44,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from k8s_gpu_hpa_tpu.obs import coverage
+from k8s_gpu_hpa_tpu.obs import coverage, profile
 
 from k8s_gpu_hpa_tpu.metrics.rules import (
     Absent,
@@ -271,15 +271,16 @@ class QueryPlanner:
         self._plans: dict[int, tuple[Expr, Expr]] = {}
 
     def plan(self, expr: Expr) -> Expr:
-        cached = self._plans.get(id(expr))
-        if cached is not None and cached[0] is expr:
-            coverage.hit("planner_path:plan_cache_hit")
-            return cached[1]
-        plan = self._rewrite(expr)
-        self._plans[id(expr)] = (expr, plan)
-        self.stats.plans_built += 1
-        coverage.hit("planner_path:plan_built")
-        return plan
+        with profile.stage("planner:plan"):
+            cached = self._plans.get(id(expr))
+            if cached is not None and cached[0] is expr:
+                coverage.hit("planner_path:plan_cache_hit")
+                return cached[1]
+            plan = self._rewrite(expr)
+            self._plans[id(expr)] = (expr, plan)
+            self.stats.plans_built += 1
+            coverage.hit("planner_path:plan_built")
+            return plan
 
     def invalidate(self) -> None:
         self._plans.clear()

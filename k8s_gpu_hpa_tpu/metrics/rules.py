@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 
 from k8s_gpu_hpa_tpu.metrics.schema import Sample, TPU_DUTY_CYCLE, TPU_TENSORCORE_UTIL
 from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import profile
 
 Vector = list[Sample]
 
@@ -735,6 +736,10 @@ class RuleEvaluator:
         self.planner = planner
 
     def evaluate_once(self) -> int:
+        with profile.stage("rules:eval"):
+            return self._evaluate_once()
+
+    def _evaluate_once(self) -> int:
         planner = self.planner
 
         def plan_for(rule):
@@ -749,16 +754,20 @@ class RuleEvaluator:
         for rule in self.rules:
             plan = plan_for(rule)
             if plan is None:
-                count += rule.evaluate_into(
-                    self.db, tracer=self.tracer, selfmetrics=self.selfmetrics
-                )
+                with profile.stage("rules:eval_fallback"):
+                    count += rule.evaluate_into(
+                        self.db,
+                        tracer=self.tracer,
+                        selfmetrics=self.selfmetrics,
+                    )
             else:
-                count += rule.evaluate_into(
-                    self.db,
-                    tracer=self.tracer,
-                    selfmetrics=self.selfmetrics,
-                    plan=plan,
-                )
+                with profile.stage("rules:eval_planned"):
+                    count += rule.evaluate_into(
+                        self.db,
+                        tracer=self.tracer,
+                        selfmetrics=self.selfmetrics,
+                        plan=plan,
+                    )
         for alert in self.alerts:
             alert.evaluate(self.db, plan=plan_for(alert))
         return count

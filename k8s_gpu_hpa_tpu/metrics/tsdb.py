@@ -87,6 +87,7 @@ from k8s_gpu_hpa_tpu.metrics.gorilla import (
     decode as gorilla_decode,
 )
 from k8s_gpu_hpa_tpu.metrics.schema import Exemplar, MetricFamily, Sample
+from k8s_gpu_hpa_tpu.obs import profile
 from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
 
 LabelSet = tuple[tuple[str, str], ...]
@@ -1479,6 +1480,10 @@ class Scraper:
         series go stale at the next scrape, they don't linger for the lookback
         window), an ``up`` sample of 0, and an exponential backoff before the
         next attempt.  Returns number of samples ingested."""
+        with profile.stage("scrape:sweep"):
+            return self._scrape_once()
+
+    def _scrape_once(self) -> int:
         count = 0
         # per-sweep invariants, hoisted: a 1000-target fleet pays every
         # per-target attribute chase 1000 times per tick (the clock cannot
@@ -1547,47 +1552,51 @@ class Scraper:
                 # a real scraper would (tests prove path equivalence)
                 text = fetched.text if isinstance(fetched, TimedExposition) else fetched
                 families = parse_text(text)
-            produced: set[tuple[str, LabelSet]] = set()
-            attached = target.attached_labels
-            merge_cache = target.merge_cache
-            for fam in families:
-                fam_name = fam.name
-                for sample in fam.samples:
-                    if attached:
-                        key = merge_cache.get(sample.labels)
-                        if key is None:
-                            merged = dict(sample.labels)
-                            merged.update(attached)
-                            key = tuple(sorted(merged.items()))
-                            merge_cache[sample.labels] = key
-                    else:
-                        # parse_text and Sample.make both emit sorted label
-                        # tuples, so the sample's labels ARE the series key
-                        key = sample.labels
-                    # histogram samples carry a suffix: the TSDB series is
-                    # the full wire name (x_bucket/x_sum/x_count)
-                    series_name = (
-                        fam_name + sample.suffix if sample.suffix else fam_name
-                    )
-                    db_append(
-                        series_name,
-                        key,
-                        sample.value,
-                        ts,
-                        origin=origin,
-                        exemplar=sample.exemplar,
-                    )
-                    produced.add((series_name, key))
-                    count += 1
-            # series that vanished from the exposition also go stale
-            for name, labels in target.last_series - produced:
-                self.db.mark_stale(name, labels, ts, origin=origin)
-            target.last_series = produced
-            # inlined _record_up (hot: once per healthy target per sweep)
-            up_labels = target.up_labels
-            if up_labels is None:
-                up_labels = self._up_labels(target)
-            db_append("up", up_labels, 1.0, ts)
+            with profile.stage("tsdb:append"):
+                produced: set[tuple[str, LabelSet]] = set()
+                attached = target.attached_labels
+                merge_cache = target.merge_cache
+                for fam in families:
+                    fam_name = fam.name
+                    for sample in fam.samples:
+                        if attached:
+                            key = merge_cache.get(sample.labels)
+                            if key is None:
+                                merged = dict(sample.labels)
+                                merged.update(attached)
+                                key = tuple(sorted(merged.items()))
+                                merge_cache[sample.labels] = key
+                        else:
+                            # parse_text and Sample.make both emit sorted
+                            # label tuples, so the sample's labels ARE the
+                            # series key
+                            key = sample.labels
+                        # histogram samples carry a suffix: the TSDB series
+                        # is the full wire name (x_bucket/x_sum/x_count)
+                        series_name = (
+                            fam_name + sample.suffix
+                            if sample.suffix
+                            else fam_name
+                        )
+                        db_append(
+                            series_name,
+                            key,
+                            sample.value,
+                            ts,
+                            origin=origin,
+                            exemplar=sample.exemplar,
+                        )
+                        produced.add((series_name, key))
+                        count += 1
+                # series that vanished from the exposition also go stale
+                for name, labels in target.last_series - produced:
+                    self.db.mark_stale(name, labels, ts, origin=origin)
+                target.last_series = produced
+                # inlined _record_up (hot: once per healthy target/sweep)
+                up_labels = target.up_labels
+                if up_labels is None:
+                    up_labels = self._up_labels(target)
+                db_append("up", up_labels, 1.0, ts)
             if selfmetrics is not None:
                 self._observe_scrape(target, wall_start, duration, origin)
             if span is not None:

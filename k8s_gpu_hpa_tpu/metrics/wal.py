@@ -40,7 +40,7 @@ import os
 import re
 from pathlib import Path
 
-from k8s_gpu_hpa_tpu.obs import coverage
+from k8s_gpu_hpa_tpu.obs import coverage, profile
 
 _SEGMENT_RE = re.compile(r"^wal-(\d{8})\.jsonl$")
 SNAPSHOT_NAME = "snapshot.json"
@@ -113,12 +113,13 @@ class WriteAheadLog:
         self._write_line(json.dumps(rec, separators=(",", ":")))
 
     def _write_line(self, line: str) -> None:
-        if self._fh is None or self._seg_records >= self.segment_max_records:
-            self._rotate()
-        self._fh.write(line + "\n")
-        self._fh.flush()
-        self._seg_records += 1
-        self.records_written += 1
+        with profile.stage("wal:flush"):
+            if self._fh is None or self._seg_records >= self.segment_max_records:
+                self._rotate()
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            self._seg_records += 1
+            self.records_written += 1
 
     def _rotate(self) -> None:
         """Seal the active segment (if any) and open the next one."""

@@ -6,11 +6,20 @@ stage (trace.py, validated against schema.py), lineage walks from scale
 events back to raw chip sweeps (lineage.py), signal-propagation latency
 measurement (latency.py), the pipeline's own Prometheus self-metrics —
 gauges plus latency histograms with trace exemplars (selfmetrics.py) —
-and declared SLOs with multi-window burn-rate alerting (slo.py).  Wired
-in by control/loop.py when a Tracer is passed to AutoscalingPipeline;
-surfaced by ``python -m k8s_gpu_hpa_tpu.simulate trace``/``slo``,
-bench.py's ``signal_latency``/``slo_burn`` rungs, and the chaos storm's
-span-annotated RecoveryReports.
+declared SLOs with multi-window burn-rate alerting (slo.py), decision-path
+coverage probes (coverage.py), and per-stage wall-clock cost attribution
+(profile.py).  Wired in by control/loop.py when a Tracer is passed to
+AutoscalingPipeline; surfaced by ``python -m k8s_gpu_hpa_tpu.simulate
+trace``/``slo``/``coverage``/``profile``, bench.py's rungs, and the chaos
+storm's span-annotated RecoveryReports.
+
+Import structure note: ``selfmetrics`` and ``slo`` import from
+``k8s_gpu_hpa_tpu.metrics``, while the metrics hot path (tsdb/rules/
+downsample) imports ``obs.profile`` for its stage brackets.  To keep that
+acyclic, this package eagerly imports only the metrics-free submodules
+(coverage, profile, trace, schema, latency, lineage) and resolves the
+selfmetrics/slo names lazily on first attribute access (PEP 562) — by
+which time the metrics package is fully initialized.
 """
 
 from k8s_gpu_hpa_tpu.obs.coverage import (
@@ -35,42 +44,72 @@ from k8s_gpu_hpa_tpu.obs.latency import (
     propagation_report,
 )
 from k8s_gpu_hpa_tpu.obs.lineage import format_lineage, index_spans, lineage_of
+from k8s_gpu_hpa_tpu.obs.profile import (
+    PROFILE_ATTRIBUTION_RATIO,
+    PROFILE_METRIC_NAMES,
+    PROFILE_STAGE_CALLS,
+    PROFILE_STAGE_SECONDS,
+    STAGES,
+    ProfileMap,
+    Stage,
+    profile_families,
+    render_scorecard as render_profile_scorecard,
+    stage_ids,
+    stages_in_domain,
+)
 from k8s_gpu_hpa_tpu.obs.schema import (
     LINEAGE_ORDER,
     SPAN_SCHEMA,
     validate_span_fields,
 )
-from k8s_gpu_hpa_tpu.obs.selfmetrics import (
-    ADAPTER_QUERY_LATENCY,
-    DECISION_REASONS,
-    HPA_DECISION_TOTAL,
-    HPA_SYNC_DURATION,
-    HPA_SYNC_LATENCY,
-    RULE_EVAL_LATENCY,
-    RULE_EVAL_STALENESS,
-    SCRAPE_DURATION,
-    SCRAPE_LATENCY,
-    SELF_HISTOGRAM_NAMES,
-    SELF_HISTOGRAM_SERIES,
-    SELF_METRIC_NAMES,
-    SELF_TARGET_NAME,
-    SIGNAL_PROPAGATION,
-    SIGNAL_PROPAGATION_BUCKETS,
-    PipelineSelfMetrics,
-    decision_reason_label,
-)
-from k8s_gpu_hpa_tpu.obs.slo import (
-    PROPAGATION_BUDGET_SECONDS,
-    SLO_EVENTS_TOTAL,
-    SLO_GOOD_TOTAL,
-    SLODefinition,
-    SLORecorder,
-    burn_rate_alerts,
-    shipped_slo_alerts,
-    shipped_slo_recorders,
-    shipped_slos,
-)
 from k8s_gpu_hpa_tpu.obs.trace import Span, Tracer, read_jsonl
+
+#: lazily-resolved names -> their metrics-importing submodule (see module
+#: docstring); ``from k8s_gpu_hpa_tpu.obs import X`` still works for all
+#: of them via module __getattr__
+_LAZY_SUBMODULE = {
+    "ADAPTER_QUERY_LATENCY": "selfmetrics",
+    "DECISION_REASONS": "selfmetrics",
+    "HPA_DECISION_TOTAL": "selfmetrics",
+    "HPA_SYNC_DURATION": "selfmetrics",
+    "HPA_SYNC_LATENCY": "selfmetrics",
+    "RULE_EVAL_LATENCY": "selfmetrics",
+    "RULE_EVAL_STALENESS": "selfmetrics",
+    "SCRAPE_DURATION": "selfmetrics",
+    "SCRAPE_LATENCY": "selfmetrics",
+    "SELF_HISTOGRAM_NAMES": "selfmetrics",
+    "SELF_HISTOGRAM_SERIES": "selfmetrics",
+    "SELF_METRIC_NAMES": "selfmetrics",
+    "SELF_TARGET_NAME": "selfmetrics",
+    "SIGNAL_PROPAGATION": "selfmetrics",
+    "SIGNAL_PROPAGATION_BUCKETS": "selfmetrics",
+    "PipelineSelfMetrics": "selfmetrics",
+    "decision_reason_label": "selfmetrics",
+    "PROPAGATION_BUDGET_SECONDS": "slo",
+    "SLO_EVENTS_TOTAL": "slo",
+    "SLO_GOOD_TOTAL": "slo",
+    "SLODefinition": "slo",
+    "SLORecorder": "slo",
+    "burn_rate_alerts": "slo",
+    "shipped_slo_alerts": "slo",
+    "shipped_slo_recorders": "slo",
+    "shipped_slos": "slo",
+}
+
+
+def __getattr__(name: str):
+    submodule = _LAZY_SUBMODULE.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{submodule}")
+    value = getattr(module, name)
+    globals()[name] = value
+    return value
+
 
 __all__ = [
     "ADAPTER_QUERY_LATENCY",
@@ -86,9 +125,14 @@ __all__ = [
     "HPA_SYNC_LATENCY",
     "LINEAGE_ORDER",
     "PROBES",
+    "PROFILE_ATTRIBUTION_RATIO",
+    "PROFILE_METRIC_NAMES",
+    "PROFILE_STAGE_CALLS",
+    "PROFILE_STAGE_SECONDS",
     "PROPAGATION_BUDGET_SECONDS",
     "PipelineSelfMetrics",
     "Probe",
+    "ProfileMap",
     "RULE_EVAL_LATENCY",
     "RULE_EVAL_STALENESS",
     "SCRAPE_DURATION",
@@ -104,7 +148,9 @@ __all__ = [
     "SLODefinition",
     "SLORecorder",
     "SPAN_SCHEMA",
+    "STAGES",
     "Span",
+    "Stage",
     "TracedLoad",
     "Tracer",
     "burn_rate_alerts",
@@ -118,11 +164,15 @@ __all__ = [
     "percentile",
     "probe_ids",
     "probes_in_domain",
+    "profile_families",
     "propagation_report",
     "read_jsonl",
+    "render_profile_scorecard",
     "render_scorecard",
     "shipped_slo_alerts",
     "shipped_slo_recorders",
     "shipped_slos",
+    "stage_ids",
+    "stages_in_domain",
     "validate_span_fields",
 ]

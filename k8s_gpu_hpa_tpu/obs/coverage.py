@@ -49,6 +49,7 @@ DOMAINS = (
     "recovery_path",
     "concurrency",
     "fuzz",
+    "profile",
 )
 
 EXPORT_VERSION = 1
@@ -343,6 +344,31 @@ probe(
     "fuzz",
     "corpus_replay",
     "a committed seed+schedule corpus artifact was replayed",
+)
+
+# -- profile: the continuous-profiling plane's own decision paths
+# (obs/profile.py) — the profiler measures the sim, and its gates are
+# themselves probed so `simulate coverage --run profile` proves the
+# diff/attribution/export machinery end to end.
+probe(
+    "profile",
+    "diff_regression",
+    "profile --diff found a lost path or stage-share regression",
+)
+probe(
+    "profile",
+    "unattributed_overflow",
+    "a run's unattributed time bucket exceeded the attribution floor",
+)
+probe(
+    "profile",
+    "export_trace",
+    "Chrome trace_event JSON exporter rendered a profile",
+)
+probe(
+    "profile",
+    "export_flame",
+    "collapsed-stack (flamegraph) exporter rendered a profile",
 )
 
 

@@ -199,6 +199,10 @@ COVERAGE_DOMAIN_FLOORS = {
     # measured 1.00 — a loop edit that stops exercising a whole joint
     # (e.g. the minimizer never running) trips this floor
     "fuzz": 0.75,
+    # the profile coverage session (control/profile_harness) fires all
+    # four probes synthetically (both exporters, a real-vs-empty diff,
+    # an empty-map attribution check); measured 1.00
+    "profile": 0.75,
 }
 
 # ---- race_sweep smoke (tools/tier1.sh, `simulate races`) -------------------
@@ -255,3 +259,40 @@ FUZZ_MAX_SHRINK_RATIO = 0.67
 #: minimizer/replay probes are also hit deterministically
 FUZZ_COVERAGE_BUDGET = 4
 FUZZ_COVERAGE_SEED = 11
+
+# ---- continuous profiling: the obs/profile.py plane (ISSUE 17) -------------
+
+#: attribution floor the profile_bench rung gates on for the scale run:
+#: at least this share of run_fleet_scale's own measured (gc-disabled)
+#: wall window must land inside named stage brackets — i.e. the
+#: "unattributed" bucket of the time the bench already measures stays
+#: under 10%
+PROFILE_MIN_ATTRIBUTION = 0.90
+
+#: --diff regression tolerance: a stage's share of attributed self time
+#: may grow by at most this many absolute share points over the baseline.
+#: Shares (not seconds) make the gate machine-portable — a uniformly
+#: slower machine cancels out — and 0.25 is generous enough that only a
+#: real hot-spot shift (like the planted canary) trips it
+PROFILE_DIFF_SHARE_TOLERANCE = 0.25
+#: stages below this candidate self time are exempt from the share gate
+#: (sub-5ms totals are all jitter)
+PROFILE_DIFF_MIN_SELF_S = 0.005
+
+#: the planted-slowdown canary the profile_bench rung proves the diff
+#: gate catches: PROFILE_CANARY_PLANT_S fake seconds added per call to
+#: this stage must push its share past the tolerance vs a clean run
+PROFILE_CANARY_STAGE = "tsdb:append"
+PROFILE_CANARY_PLANT_S = 0.05
+
+#: scale-run shapes for run_profile: full = the sim_scale shape the
+#: attribution gate is specified at; smoke = CI/tier1 sizing
+PROFILE_SCALE_TARGETS = 1000
+PROFILE_SCALE_HORIZON_S = 3600.0
+PROFILE_SCALE_SMOKE_TARGETS = 200
+PROFILE_SCALE_SMOKE_HORIZON_S = 600.0
+
+#: the `coverage --run profile` session's tiny fleet shape — just enough
+#: scrape/eval traffic to populate a real ProfileMap for the exporters
+PROFILE_COVERAGE_TARGETS = 10
+PROFILE_COVERAGE_HORIZON_S = 120.0

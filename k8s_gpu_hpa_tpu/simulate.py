@@ -419,7 +419,7 @@ def render_slo_report(result: dict) -> str:
 
 #: the canned runs ``simulate coverage`` can collect under one map — the
 #: same six the coverage_floor bench rung unions (bench.py)
-COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo", "races", "fuzz")
+COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo", "races", "fuzz", "profile")
 
 
 def run_coverage(run: str = "all", seed: int | None = None) -> dict:
@@ -454,6 +454,15 @@ def run_coverage(run: str = "all", seed: int | None = None) -> dict:
                 # perfgates (they guarantee all four fuzz:* probes fire);
                 # --seed varies the storm/races, not the fuzz campaign
                 run_fuzz_coverage_session()
+            elif name == "profile":
+                # fires all four profile:* probes deterministically (tiny
+                # profiled fleet run + both exporters + synthetic
+                # diff/attribution trips — control/profile_harness.py)
+                from k8s_gpu_hpa_tpu.control.profile_harness import (
+                    run_profile_coverage_session,
+                )
+
+                run_profile_coverage_session()
     return cmap.export()
 
 
@@ -1036,6 +1045,12 @@ def main(args) -> int:
 
         diff_paths = getattr(args, "diff", None)
         if diff_paths:
+            if len(diff_paths) != 2:
+                print(
+                    "simulate coverage --diff wants exactly two exports: "
+                    "BASELINE CANDIDATE"
+                )
+                return 2
             try:
                 a = _json.loads(Path(diff_paths[0]).read_text())
                 b = _json.loads(Path(diff_paths[1]).read_text())
@@ -1077,6 +1092,113 @@ def main(args) -> int:
                 )
                 return 2
             print(f"union {union:.3f} meets declared floor {floor:.3f}")
+        return 0
+
+    if args.scenario == "profile":
+        # the continuous-profiling plane (obs/profile.py): run the canned
+        # scenario(s) under a ProfileMap and print the per-stage scorecard
+        # with % attribution; --json exports the timed map, --trace-out /
+        # --flame-out write the Chrome trace / collapsed-stack renderings,
+        # --diff gates against a baseline export (exit 2 on regression):
+        # two paths diff offline, one path diffs this run against it
+        import json as _json
+
+        from k8s_gpu_hpa_tpu.control.profile_harness import (
+            PROFILE_RUNS,
+            run_profile,
+        )
+        from k8s_gpu_hpa_tpu.obs import profile as profmod
+
+        diff_paths = getattr(args, "diff", None) or []
+        if len(diff_paths) > 2:
+            print(
+                "simulate profile --diff wants one export (run, then diff "
+                "this run against it) or two (diff offline)"
+            )
+            return 2
+        if len(diff_paths) == 2:
+            try:
+                a = _json.loads(Path(diff_paths[0]).read_text())
+                b = _json.loads(Path(diff_paths[1]).read_text())
+            except (OSError, ValueError) as e:
+                print(f"simulate profile --diff: {e}")
+                return 2
+            diff = profmod.diff_exports(a, b)
+            print(profmod.render_profile_diff(diff))
+            return 2 if diff["regression"] else 0
+
+        run = getattr(args, "run", None) or "storm"
+        known = PROFILE_RUNS + ("all",)
+        if run not in known:
+            print(
+                f"simulate profile: unknown run {run!r} — pick one of: "
+                f"{', '.join(known)}"
+            )
+            return 2
+        if diff_paths and run == "all":
+            print(
+                "simulate profile: --diff with a single baseline needs a "
+                "single --run (storm, crunch, or scale)"
+            )
+            return 2
+        plant = None
+        plant_arg = getattr(args, "plant", None)
+        if plant_arg:
+            stage_id, _, seconds = plant_arg.partition("=")
+            try:
+                plant = {stage_id: float(seconds)}
+            except ValueError:
+                print(
+                    f"simulate profile: --plant wants STAGE=SECONDS, "
+                    f"got {plant_arg!r}"
+                )
+                return 2
+        try:
+            records = run_profile(
+                run=run,
+                seed=getattr(args, "seed", None),
+                smoke=bool(getattr(args, "smoke", False)),
+                plant=plant,
+            )
+        except KeyError as e:
+            print(f"simulate profile: {e.args[0]}")
+            return 2
+        for i, rec in enumerate(records):
+            if i:
+                print()
+            print(profmod.render_scorecard(rec["timed"]))
+        last = records[-1]
+        json_path = getattr(args, "json_out", None)
+        if json_path:
+            Path(json_path).write_text(
+                _json.dumps(
+                    last["timed"], sort_keys=True, separators=(",", ":")
+                )
+                + "\n"
+            )
+            print(f"wrote {json_path}")
+        trace_path = getattr(args, "trace_out", None)
+        if trace_path:
+            Path(trace_path).write_text(
+                profmod.render_chrome_trace(last["pmap"])
+            )
+            print(f"wrote {trace_path} (chrome://tracing / Perfetto)")
+        flame_path = getattr(args, "flame_out", None)
+        if flame_path:
+            Path(flame_path).write_text(
+                profmod.render_collapsed(last["pmap"], last["wall_s"])
+            )
+            print(f"wrote {flame_path} (flamegraph.pl / speedscope)")
+        if diff_paths:
+            try:
+                baseline = _json.loads(Path(diff_paths[0]).read_text())
+            except (OSError, ValueError) as e:
+                print(f"simulate profile --diff: {e}")
+                return 2
+            diff = profmod.diff_exports(baseline, last["timed"])
+            print()
+            print(profmod.render_profile_diff(diff))
+            return 2 if diff["regression"] else 0
         return 0
 
     if args.scenario == "chaos":
@@ -1400,6 +1522,7 @@ if __name__ == "__main__":
             "coverage",
             "races",
             "fuzz",
+            "profile",
         ],
     )
     parser.add_argument(
@@ -1434,8 +1557,10 @@ if __name__ == "__main__":
     )
     parser.add_argument(
         "--trace-out",
-        default="trace.jsonl",
-        help="JSONL span export path for the 'trace' scenario",
+        default=None,
+        help="JSONL span export path for the 'trace' scenario (default "
+        "trace.jsonl); for 'profile', write the run's Chrome trace_event "
+        "JSON here (only when given)",
     )
     parser.add_argument(
         "--components",
@@ -1454,7 +1579,9 @@ if __name__ == "__main__":
         "--run",
         default=None,
         help="which canned run the 'coverage' scenario collects "
-        "(storm, crunch, drill, slo, races, fuzz, or all; default all)",
+        "(storm, crunch, drill, slo, races, fuzz, profile, or all; "
+        "default all) or the 'profile' scenario measures "
+        "(storm, crunch, scale, or all; default storm)",
     )
     parser.add_argument(
         "--seed",
@@ -1512,15 +1639,40 @@ if __name__ == "__main__":
         default=None,
         metavar="PATH",
         help="write the 'coverage' scenario's canonical CoverageMap "
-        "export to PATH (bit-identical across same-seed runs)",
+        "export (bit-identical across same-seed runs) or the 'profile' "
+        "scenario's timed ProfileMap export to PATH",
     )
     parser.add_argument(
         "--diff",
-        nargs=2,
+        nargs="+",
         default=None,
-        metavar=("BASELINE", "CANDIDATE"),
-        help="diff two 'coverage' --json exports instead of running "
-        "anything; exit 2 if the candidate lost any probe",
+        metavar="EXPORT",
+        help="coverage: diff two --json exports instead of running "
+        "anything (exit 2 if the candidate lost any probe); profile: "
+        "with two paths diff them offline, with one path run then diff "
+        "this run against the baseline (exit 2 on a lost call path or a "
+        "stage-share regression past the perfgates tolerance)",
+    )
+    parser.add_argument(
+        "--flame-out",
+        default=None,
+        metavar="PATH",
+        help="profile: write the run's collapsed-stack rendering "
+        "(flamegraph.pl / speedscope compatible) to PATH",
+    )
+    parser.add_argument(
+        "--plant",
+        default=None,
+        metavar="STAGE=SECONDS",
+        help="profile: add artificial SECONDS to every call of STAGE in "
+        "the accounting (the regression canary for exercising --diff; "
+        "no real sleep happens)",
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="profile: shrink the 'scale' run to the CI smoke shape "
+        "(perfgates.PROFILE_SCALE_SMOKE_*)",
     )
     parser.add_argument(
         "--floor",

@@ -217,6 +217,17 @@ def test_time_budget_completes_unattended_with_labeled_skips():
     assert crunch["provision_failures"] >= 1
     assert crunch["violations"] == []
     assert crunch["ok"] is True
+    # profile_bench rung contract: the profiling plane attributes the scale
+    # run's wall window, same-seed exports stay bit-identical, and the
+    # planted canary trips the diff gate — cheap enough to run budgeted
+    # (smoke shape under TIME_SCALE), so the summary line must say ok
+    assert summary["rungs"].get("profile_bench") == "ok"
+    profile_bench = final["rungs"]["profile_bench"]
+    for key in ("attribution", "stages", "bit_identical", "canary_caught"):
+        assert key in profile_bench, f"profile_bench rung missing {key!r}"
+    assert profile_bench["open_spans"] == []
+    assert profile_bench["clean_diff_regression"] is False
+    assert profile_bench["ok"] is True
     assert [c["pod_start_s"] for c in final["pod_start_sensitivity"]] == [
         12.0,
         30.0,

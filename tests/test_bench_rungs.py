@@ -385,6 +385,97 @@ def test_chaos_fuzz_rung_pins_keys_and_gate_logic(monkeypatch):
     assert result["ok"] is False
 
 
+def test_profile_bench_rung_pins_keys_and_gate_logic(monkeypatch):
+    """The continuous-profiling rung (obs/profile.py): pin the record shape
+    and the ok-conjunction with the profiled runs stubbed (the real
+    attribution/determinism/canary proofs run in tests/test_profile.py; the
+    rung re-proves them at full sim_scale shape on every unbudgeted run)."""
+    import bench as bench_mod
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.control import profile_harness
+
+    def fake_record(run, plant=None):
+        # two-path profile: scrape:sweep dominating, tsdb:append riding
+        # under it; a plant on tsdb:append flips the dominant share
+        append_self = 5.0 if plant else 0.1
+        paths = {
+            "scrape:sweep": {
+                "stage": "scrape:sweep",
+                "domain": "scrape",
+                "depth": 1,
+                "count": 4,
+                "self_s": 0.8,
+                "cum_s": 0.8 + append_self,
+            },
+            "scrape:sweep;tsdb:append": {
+                "stage": "tsdb:append",
+                "domain": "tsdb",
+                "depth": 2,
+                "count": 4,
+                "self_s": append_self,
+                "cum_s": append_self,
+            },
+        }
+        timed = {"run": run, "paths": paths, "wall_s": 1.0}
+        return {
+            "run": run,
+            "wall_s": 1.0,
+            "canonical": '{"run":"%s"}' % run,
+            "timed": timed,
+            "attribution": 0.95,
+            "attribution_ok": True,
+            "open_spans": [],
+        }
+
+    def fake_run_profile(run="storm", seed=None, smoke=False, plant=None):
+        return [fake_record(run, plant=plant)]
+
+    monkeypatch.setattr(profile_harness, "run_profile", fake_run_profile)
+    result = bench_mod.run_rung_profile_bench()
+    assert set(result) == {
+        "mode",
+        "metric",
+        "scale_targets",
+        "scale_wall_s",
+        "attribution",
+        "attribution_floor",
+        "stages",
+        "open_spans",
+        "bit_identical",
+        "canary_stage",
+        "canary_plant_s",
+        "canary_caught",
+        "clean_diff_regression",
+        "ok",
+    }
+    assert result["mode"] == "measured"
+    assert result["attribution_floor"] == perfgates.PROFILE_MIN_ATTRIBUTION
+    assert result["canary_stage"] == perfgates.PROFILE_CANARY_STAGE
+    assert result["canary_plant_s"] == perfgates.PROFILE_CANARY_PLANT_S
+    # per-stage breakdown: a rollup over call paths, keyed by stage id
+    assert result["stages"]["tsdb:append"]["calls"] == 4
+    assert result["stages"]["scrape:sweep"]["self_s"] == 0.8
+    assert result["bit_identical"] is True
+    assert result["canary_caught"] is True
+    assert result["clean_diff_regression"] is False
+    assert result["ok"] is True
+
+    # the gate is a genuine conjunction: canonical exports that drift
+    # between same-seed runs fail the rung even with the canary caught
+    calls = {"n": 0}
+
+    def drifting_run_profile(run="storm", seed=None, smoke=False, plant=None):
+        calls["n"] += 1
+        rec = fake_record(run, plant=plant)
+        rec["canonical"] = '{"call":%d}' % calls["n"]
+        return [rec]
+
+    monkeypatch.setattr(profile_harness, "run_profile", drifting_run_profile)
+    result = bench_mod.run_rung_profile_bench()
+    assert result["bit_identical"] is False
+    assert result["ok"] is False
+
+
 def test_coverage_floor_rung_gates_union_domains_and_gap_list():
     """The execution-coverage rung (obs/coverage.py): the four-scenario
     union must clear every declared floor AND still leave a non-empty

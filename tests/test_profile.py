@@ -1,0 +1,325 @@
+"""Continuous-profiling plane (obs/profile.py, ISSUE 17).
+
+What must hold for the cost-attribution plane to be trustworthy:
+
+- registry discipline: stable ids, duplicate/unknown stages rejected;
+- zero-cost-when-off: with no active map a bracket is a shared no-op;
+- accounting: self/cum/count math under nesting, strict balance errors,
+  exception-safe exit (a fault raising mid-stage can't leak a span);
+- determinism: same-seed storm runs export bit-identical structure, and
+  the trace/flame renderings are structure-identical modulo timings;
+- the --diff gate: clean runs pass, a planted slowdown provably trips it
+  (exit-code matrix through the simulate CLI);
+- the coverage session fires every profile:* probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import pytest
+
+from k8s_gpu_hpa_tpu import perfgates
+from k8s_gpu_hpa_tpu.control.profile_harness import (
+    PROFILE_RUNS,
+    run_profile,
+    run_profile_coverage_session,
+)
+from k8s_gpu_hpa_tpu.obs import coverage, profile
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+def test_stage_registry_is_stable():
+    # the bracket map the baselines key on — renaming/removing any of
+    # these invalidates committed profile exports, so pin them
+    assert profile.stage_ids() == [
+        "adapter:query",
+        "capacity:try_place",
+        "downsample:compact",
+        "harness:observe",
+        "hpa:sync",
+        "planner:plan",
+        "rules:eval",
+        "rules:eval_fallback",
+        "rules:eval_planned",
+        "scrape:sweep",
+        "tsdb:append",
+        "wal:flush",
+    ]
+    for stage_id, stage in profile.STAGES.items():
+        assert stage.stage_id == stage_id
+        assert stage.domain in profile.DOMAINS
+        assert stage.description
+
+
+def test_stage_registry_rejects_duplicates_and_unknown_domains():
+    with pytest.raises(ValueError, match="duplicate"):
+        profile.stage_def("scrape", "sweep", "again")
+    with pytest.raises(ValueError, match="unknown stage domain"):
+        profile.stage_def("warp_drive", "engage", "no such domain")
+
+
+# ---- zero-cost-when-off and accounting --------------------------------------
+
+
+def test_inactive_bracket_is_shared_noop():
+    assert profile.active() is None
+    span_a = profile.stage("scrape:sweep")
+    span_b = profile.stage("tsdb:append")
+    # one shared null object, no per-call allocation, nothing recorded
+    assert span_a is span_b
+    with span_a:
+        pass
+    pmap = profile.ProfileMap("t")
+    assert pmap.export()["paths"] == {}
+
+
+def test_nested_accounting_self_cum_counts():
+    pmap = profile.ProfileMap("t")
+    profile.activate(pmap)
+    try:
+        for _ in range(3):
+            with profile.stage("rules:eval"):
+                with profile.stage("planner:plan"):
+                    pass
+    finally:
+        profile.deactivate()
+    export = pmap.timed_export(1.0)
+    outer = export["paths"]["rules:eval"]
+    inner = export["paths"]["rules:eval;planner:plan"]
+    assert outer["count"] == 3 and inner["count"] == 3
+    assert inner["depth"] == 2 and inner["stage"] == "planner:plan"
+    assert inner["domain"] == "planner"
+    # parent self excludes child time; cum includes it
+    assert outer["cum_s"] >= outer["self_s"] >= 0.0
+    assert outer["cum_s"] >= inner["cum_s"]
+    rollup = profile.stage_rollup(export)
+    assert rollup["rules:eval"]["calls"] == 3
+
+
+def test_unregistered_stage_and_unbalanced_exit_raise():
+    pmap = profile.ProfileMap("t")
+    profile.activate(pmap)
+    try:
+        with pytest.raises(KeyError, match="unregistered stage"):
+            with profile.stage("tsdb:quantum_leap"):
+                pass
+        with pytest.raises(RuntimeError, match="unbalanced"):
+            pmap._exit("scrape:sweep")
+    finally:
+        profile.deactivate()
+    with pytest.raises(KeyError, match="unregistered stage"):
+        profile.ProfileMap("t", plant={"warp:core": 1.0})
+
+
+def test_exception_unwinds_open_span():
+    # the latent bracket-nesting hazard: a fault raising mid-stage must
+    # close its span on the way out (context-manager exit), so the map
+    # stays balanced and later spans don't nest under a ghost parent
+    with profile.collect("t") as pmap:
+        with pytest.raises(RuntimeError, match="adapter blackout"):
+            with profile.stage("scrape:sweep"):
+                with profile.stage("adapter:query"):
+                    raise RuntimeError("adapter blackout")
+        assert pmap.open_spans() == []
+        with profile.stage("hpa:sync"):
+            pass
+    # the post-fault span recorded at depth 1, not under a leaked parent
+    assert "hpa:sync" in pmap.export()["paths"]
+    assert pmap.export()["paths"]["hpa:sync"]["depth"] == 1
+    # collect() deactivated on exit even though the block raised earlier
+    assert profile.active() is None
+
+
+def test_trace_event_buffer_is_bounded():
+    pmap = profile.ProfileMap("t", trace_cap=5)
+    profile.activate(pmap)
+    try:
+        for _ in range(9):
+            with profile.stage("wal:flush"):
+                pass
+    finally:
+        profile.deactivate()
+    assert pmap.events_dropped == 4
+    trace = json.loads(profile.render_chrome_trace(pmap))
+    assert len(trace["traceEvents"]) == 5
+    assert trace["otherData"]["events_dropped"] == 4
+    # the aggregate keeps counting past the raw-event cap
+    assert pmap.export()["paths"]["wal:flush"]["count"] == 9
+
+
+# ---- determinism + balance under the real fault storm -----------------------
+
+
+def test_storm_profile_is_balanced_and_bit_identical():
+    """Same-seed storm runs — full fault schedule included — must leave
+    zero open spans and export bit-identical canonical structure; the
+    trace/flame renderings must be structure-identical modulo timings."""
+    first = run_profile("storm", seed=3)[0]
+    second = run_profile("storm", seed=3)[0]
+    assert first["open_spans"] == [] and second["open_spans"] == []
+    assert first["canonical"] == second["canonical"]
+    assert json.loads(first["canonical"])["run"] == "storm@3"
+
+    def trace_structure(rec):
+        events = json.loads(profile.render_chrome_trace(rec["pmap"]))
+        return [
+            (e["name"], e["cat"], e["pid"], e["tid"], e["args"]["path"])
+            for e in events["traceEvents"]
+        ]
+
+    assert trace_structure(first) == trace_structure(second)
+
+    def flame_structure(rec):
+        lines = profile.render_collapsed(rec["pmap"]).strip().splitlines()
+        return [line.rsplit(" ", 1)[0] for line in lines]
+
+    assert flame_structure(first) == flame_structure(second)
+
+
+# ---- diff gate + planted canary ---------------------------------------------
+
+
+def _scale_pair(plant=None):
+    clean = run_profile("scale", smoke=True)[0]
+    other = run_profile("scale", smoke=True, plant=plant)[0]
+    return clean, other
+
+
+def test_diff_clean_run_passes_and_planted_canary_trips():
+    clean, second = _scale_pair()
+    ok = profile.diff_exports(clean["timed"], second["timed"])
+    assert not ok["regression"]
+    assert ok["lost"] == [] and ok["share_regressions"] == []
+
+    planted = run_profile(
+        "scale",
+        smoke=True,
+        plant={perfgates.PROFILE_CANARY_STAGE: perfgates.PROFILE_CANARY_PLANT_S},
+    )[0]
+    # the plant changes accounting, never structure
+    assert planted["canonical"] == clean["canonical"]
+    diff = profile.diff_exports(clean["timed"], planted["timed"])
+    assert diff["regression"]
+    assert any(
+        r["stage"] == perfgates.PROFILE_CANARY_STAGE
+        for r in diff["share_regressions"]
+    )
+    assert "PROFILE REGRESSION" in profile.render_profile_diff(diff)
+
+
+def test_diff_detects_lost_paths():
+    clean = run_profile("scale", smoke=True)[0]
+    empty = profile.ProfileMap("empty").timed_export(1.0)
+    diff = profile.diff_exports(clean["timed"], empty)
+    assert diff["regression"]
+    assert diff["lost"] == sorted(clean["timed"]["paths"])
+
+
+def test_run_profile_rejects_unknown_run():
+    assert PROFILE_RUNS == ("storm", "crunch", "scale")
+    with pytest.raises(ValueError, match="unknown profile run"):
+        run_profile("warp")
+
+
+# ---- attribution + metric families ------------------------------------------
+
+
+def test_attribution_and_floor_probe():
+    rec = run_profile("scale", smoke=True)[0]
+    timed = rec["timed"]
+    assert timed["attribution"] == pytest.approx(
+        timed["attributed_s"] / timed["wall_s"], abs=1e-3
+    )
+    assert profile.check_attribution(timed, floor=0.0)
+    with coverage.collect("t") as cmap:
+        assert not profile.check_attribution(
+            profile.ProfileMap("empty").timed_export(1.0),
+            perfgates.PROFILE_MIN_ATTRIBUTION,
+        )
+    assert cmap.export()["probes"]["profile:unattributed_overflow"]["count"] == 1
+
+
+def test_profile_families_names_and_labels():
+    rec = run_profile("scale", smoke=True)[0]
+    families = profile.profile_families(rec["timed"])
+    assert [f.name for f in families] == list(profile.PROFILE_METRIC_NAMES)
+    seconds, calls, ratio = families
+    stages = {dict(s.labels)["stage"] for s in seconds.samples}
+    assert "tsdb:append" in stages and "harness:observe" in stages
+    assert {dict(s.labels)["stage"] for s in calls.samples} == stages
+    (ratio_sample,) = ratio.samples
+    assert dict(ratio_sample.labels)["run"] == "scale"
+    assert ratio_sample.value == rec["attribution"]
+    text = profile.profile_exposition(rec["timed"])
+    for name in profile.PROFILE_METRIC_NAMES:
+        assert name in text
+
+
+# ---- coverage session + CLI exit-code matrix --------------------------------
+
+
+def test_coverage_session_fires_every_profile_probe():
+    with coverage.collect("t") as cmap:
+        run_profile_coverage_session()
+    probes = cmap.export()["probes"]
+    for probe_id in coverage.probes_in_domain("profile"):
+        assert probes[probe_id]["count"] >= 1, probe_id
+
+
+def _cli(tmp_path, **overrides):
+    ns = argparse.Namespace(
+        scenario="profile",
+        run="scale",
+        seed=None,
+        smoke=True,
+        plant=None,
+        diff=None,
+        json_out=None,
+        trace_out=None,
+        flame_out=None,
+    )
+    for key, value in overrides.items():
+        setattr(ns, key, value)
+    from k8s_gpu_hpa_tpu.simulate import main
+
+    return main(ns)
+
+
+def test_cli_exit_code_matrix(tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    trace = tmp_path / "run.trace.json"
+    flame = tmp_path / "run.flame.txt"
+    # clean run writing every export form: exit 0
+    assert (
+        _cli(
+            tmp_path,
+            json_out=str(baseline),
+            trace_out=str(trace),
+            flame_out=str(flame),
+        )
+        == 0
+    )
+    assert json.loads(trace.read_text())["traceEvents"]
+    assert flame.read_text().strip()
+    # run-then-diff against its own baseline: exit 0
+    assert _cli(tmp_path, diff=[str(baseline)]) == 0
+    # planted slowdown against the clean baseline: exit 2
+    plant = (
+        f"{perfgates.PROFILE_CANARY_STAGE}={perfgates.PROFILE_CANARY_PLANT_S}"
+    )
+    assert _cli(tmp_path, plant=plant, diff=[str(baseline)]) == 2
+    # offline self-diff: exit 0
+    assert _cli(tmp_path, diff=[str(baseline), str(baseline)]) == 0
+    capsys.readouterr()
+    # usable errors, all exit 2
+    assert _cli(tmp_path, run="warp") == 2
+    assert "pick one of" in capsys.readouterr().out
+    assert _cli(tmp_path, plant="tsdb:append") == 2  # no =SECONDS
+    assert _cli(tmp_path, plant="warp:core=1.0") == 2  # unknown stage
+    assert _cli(tmp_path, diff=[str(baseline)] * 3) == 2
+    assert _cli(tmp_path, run="all", diff=[str(baseline)]) == 2
+    assert _cli(tmp_path, diff=[str(tmp_path / "missing.json")] * 2) == 2

@@ -45,6 +45,11 @@ from k8s_gpu_hpa_tpu.obs.coverage import (  # noqa: E402
     COVERAGE_PROBES_HIT,
     COVERAGE_PROBES_REGISTERED,
 )
+from k8s_gpu_hpa_tpu.obs.profile import (  # noqa: E402
+    PROFILE_ATTRIBUTION_RATIO,
+    PROFILE_STAGE_CALLS,
+    PROFILE_STAGE_SECONDS,
+)
 from k8s_gpu_hpa_tpu.obs.selfmetrics import (  # noqa: E402
     ADAPTER_QUERY_LATENCY,
     DECODE_CACHE_HITS,
@@ -892,12 +897,71 @@ def build_dashboard() -> dict:
             ],
             "Hit ratio per probe domain (hpa_condition, scheduler_branch, "
             "planner_path, fault_kind, alert_state, recovery_path, "
-            "concurrency, fuzz).  The "
+            "concurrency, fuzz, profile).  The "
             "red line marks the union floor the coverage_floor rung gates "
             "on; one domain collapsing while the rest hold means a scenario "
             "edit stopped exercising that subsystem.",
             threshold=0.70,
             max_y=1,
+        ),
+        # ---- continuous profiling (obs/profile.py): where the measured
+        # wall time of the last profiled run actually went ----
+        _ts_panel(
+            42,
+            "Profiling: self seconds per stage",
+            0,
+            160,
+            [
+                _target(
+                    f"{PROFILE_STAGE_SECONDS}",
+                    "{{stage}}",
+                    "A",
+                )
+            ],
+            "Attributed self wall-seconds per instrumented stage in the "
+            "most recent profiled run (obs/profile.py; `simulate profile`). "
+            "The hottest line is where the ROADMAP item-3 rewrite should "
+            "aim first; a stage's share jumping between runs is exactly "
+            "what the profile --diff gate trips on.",
+            unit="s",
+        ),
+        _ts_panel(
+            43,
+            "Profiling: bracket calls per stage",
+            12,
+            160,
+            [
+                _target(
+                    f"{PROFILE_STAGE_CALLS}",
+                    "{{stage}}",
+                    "A",
+                )
+            ],
+            "Bracket entries per stage in the profiled run.  Calls "
+            "climbing while self-seconds hold is healthy scaling; "
+            "self-seconds climbing at flat calls means each call got "
+            "slower — the per-call regression the share gate normalizes "
+            "away, visible here.",
+        ),
+        _ts_panel(
+            44,
+            "Profiling: wall-time attribution ratio",
+            0,
+            168,
+            [
+                _target(
+                    f"{PROFILE_ATTRIBUTION_RATIO}",
+                    "{{run}}",
+                    "A",
+                )
+            ],
+            "Share of the run's measured wall window inside named stage "
+            "brackets.  The red line is the profile_bench floor "
+            "(perfgates.PROFILE_MIN_ATTRIBUTION) gated at the sim_scale "
+            "shape; sinking below it means un-named time crept in and the "
+            "bracket map needs a new joint.",
+            threshold=0.90,
+            max_y=1.2,
         ),
     ]
     return {

@@ -8,11 +8,19 @@ regresses:
 Usage:
     python tools/profile_sim.py                          # full 1000x1h run
     python tools/profile_sim.py --targets 200 --horizon 600
-    python tools/profile_sim.py --profile                # cProfile top-25
+    python tools/profile_sim.py --profile                # stage scorecard
+    python tools/profile_sim.py --cprofile               # cProfile top-25
     python tools/profile_sim.py --json                   # machine output
     python tools/profile_sim.py --smoke --assert-gates   # tier-1 smoke
     python tools/profile_sim.py --preset sim_scale_10k --smoke \
         --assert-gates                                   # sharded smoke
+
+``--profile`` is a thin adapter over the continuous-profiling plane
+(obs/profile.py): the run executes under a ProfileMap and prints the
+per-stage scorecard with % attribution — the same brackets, exporters,
+and diff gate ``python -m k8s_gpu_hpa_tpu.simulate profile`` surfaces.
+``--cprofile`` keeps the raw function-level cProfile view for the cases
+stage brackets are too coarse for.
 
 Every threshold comes from ``k8s_gpu_hpa_tpu.perfgates`` — the single
 shared constants module — so re-baselining a gate is one edit there, not
@@ -100,7 +108,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--scrape-interval", type=float, default=15.0)
     parser.add_argument("--rule-interval", type=float, default=5.0)
     parser.add_argument(
-        "--profile", action="store_true", help="run under cProfile, print top-25"
+        "--profile",
+        "--stages",
+        action="store_true",
+        dest="profile",
+        help="run under the obs/profile stage plane and print the "
+        "per-stage scorecard (see `simulate profile` for diff/export)",
+    )
+    parser.add_argument(
+        "--cprofile",
+        action="store_true",
+        help="fallback: run under cProfile, print top-25 by cumulative",
     )
     parser.add_argument("--json", action="store_true", help="emit one JSON object")
     parser.add_argument(
@@ -136,13 +154,19 @@ def main(argv: list[str] | None = None) -> int:
             shards=shards,
         )
 
-    if args.profile:
+    if args.cprofile:
         import cProfile
         import pstats
 
         profiler = cProfile.Profile()
         result = profiler.runcall(run)
         pstats.Stats(profiler).sort_stats("cumulative").print_stats(25)
+    elif args.profile:
+        from k8s_gpu_hpa_tpu.obs import profile as profmod
+
+        with profmod.collect(args.preset) as pmap:
+            result = run()
+        print(profmod.render_scorecard(pmap.timed_export(result["wall_s"])))
     else:
         result = run()
 

@@ -58,6 +58,15 @@ python -m k8s_gpu_hpa_tpu.simulate races || exit 1
 # find/minimize proof and the bit-identity gate run in bench.py's
 # chaos_fuzz rung and tests/test_fuzz.py
 python -m k8s_gpu_hpa_tpu.simulate fuzz --budget 8 --seed 7 || exit 1
+# profile smoke: a fresh profiled storm run diffed against the committed
+# baseline export (obs/profile.py + control/profile_harness.py) — exit 2 on
+# a lost call path (the run stopped taking an instrumented joint) or a
+# stage's share of attributed self time growing past the perfgates
+# PROFILE_DIFF_SHARE_TOLERANCE; shares not seconds, so a slower CI host
+# alone cannot trip it.  Re-baseline after an intentional hot-path change:
+#   python -m k8s_gpu_hpa_tpu.simulate profile --run storm \
+#     --json tests/profiles/storm_baseline.json
+python -m k8s_gpu_hpa_tpu.simulate profile --run storm --diff tests/profiles/storm_baseline.json || exit 1
 # corpus replay: every committed scenario under tests/scenarios/ must
 # reproduce its recorded outcome fingerprint bit-for-bit — a minimized
 # fuzz failure is only a regression test if it still fails the same way
