@@ -1752,8 +1752,9 @@ def run_rung_capacity_crunch() -> dict:
 
 
 def run_rung_coverage_floor() -> dict:
-    """Execution-coverage rung (obs/coverage.py): run the six canned
-    scenarios — storm, crunch, drill, slo, races, fuzz — under ONE CoverageMap and gate
+    """Execution-coverage rung (obs/coverage.py): run the canned scenarios
+    — storm, crunch, drill, slo, races, fuzz, profile, evacuate
+    (simulate.COVERAGE_RUN_NAMES) — under ONE CoverageMap and gate
     the union against the declared floors (perfgates COVERAGE_*): union hit
     ratio, per-domain ratios, AND a minimum never-hit count (a gap list
     that went dark means coverage stopped carrying information).  The
@@ -1937,6 +1938,47 @@ def run_rung_profile_bench() -> dict:
             and canary_caught
             and not clean_diff["regression"]
         ),
+    }
+
+
+def run_rung_region_evacuation() -> dict:
+    """Multi-region evacuation rung (chaos/evacuate.py): three regional
+    stacks under one GlobalControlPlane exchange sealed format-3 snapshots
+    through a simulated object store, then region_kill takes the home region
+    away mid-traffic — through an object-store outage and a survivor
+    partition.  The acceptance bar is the fleet contract (perfgates EVAC_*):
+    per-priority-band time-to-reconvergence, zero capacity-audit violations
+    and zero starvation past budget in the surviving regions, global queries
+    bit-identical to a never-failed merged reference once reconverged, and
+    every mirror drained after the home region recovers.  The rung also
+    proves the gate can fail: the same run with spilling disabled (the
+    planted canary) must violate the contract.  Virtual time throughout;
+    deterministic run-to-run."""
+    from k8s_gpu_hpa_tpu.chaos.evacuate import run_region_evacuation
+
+    result = run_region_evacuation()
+    canary = run_region_evacuation(spill_enabled=False, smoke=True)
+    evac = result["evacuations"][0] if result["evacuations"] else {}
+    return {
+        "mode": "virtual",
+        "metric": "region evacuation (s, kill -> frozen demand Running on "
+        "survivors, per band)",
+        "ttc_s": evac.get("tenant_ttc_s", {}),
+        "ttc_budgets_s": result["ttc_budgets_s"],
+        "bands": {
+            t: result["bands"][t] for t in evac.get("frozen", {})
+        },
+        "spills_admitted": result["spills"]["admitted"],
+        "spills_denied": result["spills"]["denied"],
+        "generations": result["exchange"]["generations"],
+        "publish_failures": result["exchange"]["publish_failures"],
+        "survivor_pools_conserved": result["audits"]["alive_conserved"],
+        "bit_identical": result["global"]["bit_identical"],
+        "all_recovered": result["all_recovered"],
+        "violations": result["violations"],
+        "canary_failed": not canary["ok"],
+        "canary_violations": len(canary["violations"]),
+        "ok": result["ok"] and not canary["ok"],
     }
 
 
@@ -2513,6 +2555,7 @@ def main() -> None:
             ("downsample_bench", run_rung_downsample_bench),
             ("recovery_drill", run_rung_recovery_drill),
             ("capacity_crunch", run_rung_capacity_crunch),
+            ("region_evacuation", run_rung_region_evacuation),
             ("coverage_floor", run_rung_coverage_floor),
             ("chaos_fuzz", run_rung_chaos_fuzz),
             ("profile_bench", run_rung_profile_bench),

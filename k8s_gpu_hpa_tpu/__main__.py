@@ -213,6 +213,7 @@ def main(argv: list[str] | None = None) -> int:
             "races",
             "fuzz",
             "profile",
+            "evacuate",
         ],
         default="spike",
     )
@@ -361,7 +362,21 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument(
         "--smoke",
         action="store_true",
-        help="profile: shrink the 'scale' run to the CI smoke shape",
+        help="profile: shrink the 'scale' run to the CI smoke shape; "
+        "evacuate: shorten the kill dwell and tail",
+    )
+    sim.add_argument(
+        "--no-spill",
+        action="store_true",
+        help="evacuate: disable cross-region spilling (the planted canary; "
+        "must exit 2)",
+    )
+    sim.add_argument(
+        "--why",
+        default=None,
+        metavar="TENANT",
+        help="evacuate: replay TENANT's cross-region decision chain after "
+        "the run",
     )
     sim.add_argument(
         "--floor",

@@ -8,6 +8,13 @@ from k8s_gpu_hpa_tpu.chaos.crunch import (
     render_crunch_report,
     run_capacity_crunch,
 )
+from k8s_gpu_hpa_tpu.chaos.evacuate import (
+    evaluate_evacuation_contract,
+    render_evacuation_report,
+    render_evacuation_why,
+    replay_evacuation_artifact,
+    run_region_evacuation,
+)
 from k8s_gpu_hpa_tpu.chaos.faults import FAULT_KINDS, FaultSpec
 from k8s_gpu_hpa_tpu.chaos.schedule import ChaosSchedule, RecoveryReport
 from k8s_gpu_hpa_tpu.chaos.storm import (
@@ -28,4 +35,9 @@ __all__ = [
     "evaluate_crunch_contract",
     "render_crunch_report",
     "run_capacity_crunch",
+    "evaluate_evacuation_contract",
+    "render_evacuation_report",
+    "render_evacuation_why",
+    "replay_evacuation_artifact",
+    "run_region_evacuation",
 ]

@@ -30,6 +30,15 @@ kind                      layer it breaks
 ``provision_fail``        L0: the cluster-autoscaler's cloud API hangs —
                           provisions started in the window time out and back
                           off (control/capacity.ClusterAutoscaler)
+``region_kill``           fleet: a whole region vanishes — nodes preempted,
+                          demand frozen, the global plane must evacuate it
+                          (control/region.GlobalControlPlane.kill_region)
+``region_partition``      fleet: a region is cut off the exchange plane —
+                          stops publishing sealed snapshots, excluded as a
+                          spill target, keeps serving locally
+``objstore_outage``       fleet: the simulated object store refuses every
+                          put/get/list — global reads serve the last sealed
+                          view (metrics/objstore.SimObjectStore)
 ========================  =====================================================
 
 Injectors return a ``clear()`` callable that undoes the fault; duration-0
@@ -428,6 +437,89 @@ def _inject_provision_fail(pipe: "AutoscalingPipeline", spec: FaultSpec) -> Clea
     return clear
 
 
+def _region_plane(pipe: "AutoscalingPipeline", kind: str):
+    """Resolve the pipeline's region and global plane, or explain why the
+    region-level kind cannot bite (the ``provision_fail`` precedent: the
+    fuzzer's ``_FuzzSchedule`` records the ValueError and moves on)."""
+    region = getattr(pipe, "region", None)
+    plane = getattr(region, "plane", None) if region is not None else None
+    if plane is None:
+        raise ValueError(
+            f"{kind}: pipeline is not part of a region under a "
+            "GlobalControlPlane (wrap it in control/region.Region and "
+            "register it on a plane)"
+        )
+    return region, plane
+
+
+def _resolve_region_target(region, plane, spec: FaultSpec, kind: str) -> str:
+    target = spec.target or region.name
+    if target not in plane.regions:
+        raise ValueError(f"{kind}: no region named {target!r}")
+    return target
+
+
+def _inject_region_kill(pipe: "AutoscalingPipeline", spec: FaultSpec) -> ClearFn:
+    """A whole region dies mid-traffic: the plane freezes its demand,
+    preempts every node, and the evacuation spill must re-serve the frozen
+    replicas from surviving regions.  Kill windows nest via the plane's
+    per-region depth counter, so overlapping kills clear overlap-safe."""
+    region, plane = _region_plane(pipe, "region_kill")
+    target = _resolve_region_target(region, plane, spec, "region_kill")
+    plane.kill_region(target)
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        plane.recover_region(target)
+
+    return clear
+
+
+def _inject_region_partition(
+    pipe: "AutoscalingPipeline", spec: FaultSpec
+) -> ClearFn:
+    """Sever a region from the exchange plane: it stops publishing sealed
+    generations (global reads serve its last sealed view) and is skipped as
+    a spill target, while its local control loops keep serving."""
+    region, plane = _region_plane(pipe, "region_partition")
+    target = _resolve_region_target(region, plane, spec, "region_partition")
+    plane.partition_region(target)
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        plane.heal_partition(target)
+
+    return clear
+
+
+def _inject_objstore_outage(
+    pipe: "AutoscalingPipeline", spec: FaultSpec
+) -> ClearFn:
+    """The object store goes dark fleet-wide: publishes fail (generations
+    are not burned) and the global query layer serves its cached sealed
+    payloads.  Outage windows nest inside the store itself."""
+    _, plane = _region_plane(pipe, "objstore_outage")
+    plane.objstore.begin_outage()
+    cleared = False
+
+    def clear() -> None:
+        nonlocal cleared
+        if cleared:
+            return
+        cleared = True
+        plane.objstore.end_outage()
+
+    return clear
+
+
 FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = {
     "exporter_outage": _inject_exporter_outage,
     "frozen_samples": _inject_frozen_samples,
@@ -444,6 +536,9 @@ FAULT_KINDS: dict[str, Callable[["AutoscalingPipeline", FaultSpec], ClearFn]] = 
     "wal_truncate": _inject_wal_truncate,
     "tenant_spike": _inject_tenant_spike,
     "provision_fail": _inject_provision_fail,
+    "region_kill": _inject_region_kill,
+    "region_partition": _inject_region_partition,
+    "objstore_outage": _inject_objstore_outage,
 }
 
 

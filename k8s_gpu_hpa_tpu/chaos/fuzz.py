@@ -76,6 +76,14 @@ MUTATION_FAULT_KINDS = (
     "wal_truncate",
     "tenant_spike",
     "provision_fail",
+    # region-level kinds (ISSUE 19): the single-pipeline fuzz harness has no
+    # GlobalControlPlane, so their injectors raise ValueError there — which
+    # _FuzzSchedule records and survives, same as provision_fail without an
+    # autoscaler.  They stay in the pool so region-capable harnesses (and
+    # lint_faults' two-way sync) see the whole registry.
+    "region_kill",
+    "region_partition",
+    "objstore_outage",
 )
 
 #: impulse kinds always get duration 0 (FaultSpec semantics: clear immediately)

@@ -25,6 +25,48 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
     from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
 
 
+def pipeline_healthy(pipe: "AutoscalingPipeline") -> bool:
+    """Healthy = converged and observable: every declared replica running,
+    no pod looping, every node schedulable, every scrape target answering,
+    and the HPA able to read its metric.  Deliberately NOT "replicas ==
+    pre-fault count": load may legitimately move the goal while a fault is
+    live (a spike during a blackout); whether the *final* count is right is
+    the caller's assertion (storm/tests).
+
+    Module-level (ISSUE 19) so region-scoped callers — the
+    GlobalControlPlane's ``healthy()``, which must skip a killed region —
+    apply the SAME per-pipeline judgment the single-region schedule uses."""
+    # Every autoscaled tenant must be converged, not just the pipeline's
+    # primary deployment — on a multi-tenant pool (control/capacity.py) a
+    # fault that leaves a SECOND tenant's pods pending is not recovered,
+    # even when the primary looks fine (the latent single-tenant
+    # assumption this check used to carry).
+    controllers = [(pipe.deployment, pipe.hpa)] + [
+        (pipe.cluster.deployments[name], hpa)
+        for name, hpa in getattr(pipe, "tenant_hpas", {}).items()
+    ]
+    for dep, hpa in controllers:
+        running = len(pipe.cluster.running_pods(dep.name))
+        if running != dep.replicas:
+            return False
+        if any(
+            p.phase == "CrashLoopBackOff"
+            for p in pipe.cluster.pods.values()
+            if p.deployment == dep.name
+        ):
+            return False
+        active = hpa.status.condition("ScalingActive")
+        if active is not None and not active.status:
+            return False
+    for node in pipe.cluster.nodes.values():
+        if not (node.ready and node.schedulable):
+            return False
+    for target in pipe.scraper.targets:
+        if not target.healthy:
+            return False
+    return True
+
+
 @dataclass
 class RecoveryReport:
     """Per-fault outcome.  All timestamps are absolute clock seconds.
@@ -40,9 +82,13 @@ class RecoveryReport:
       could not restore) — stamped from ``pipeline.restart_log``.
     - ``time_to_first_good_sync``: cleared → the HPA's first sync that
       computed a valid replica count (``last_good_sync_at``).
+    - ``region``: which region's pipeline this report judged (None on the
+      single-region schedules that predate the global plane) — a dead
+      region's reports stay attributable once evacuations span regions.
     """
 
     fault: FaultSpec
+    region: str | None = None
     injected_at: float | None = None
     cleared_at: float | None = None
     detected_at: float | None = None
@@ -86,7 +132,7 @@ class RecoveryReport:
         def r(x: float | None) -> float | None:
             return None if x is None else round(x, 1)
 
-        return {
+        out = {
             "fault": self.fault.name,
             "kind": self.fault.kind,
             "injected_at": r(self.injected_at),
@@ -101,6 +147,11 @@ class RecoveryReport:
             "recovered": self.recovered,
             "trace_span_id": self.trace_span_id,
         }
+        # only regional pipelines carry the field: single-cluster outcome
+        # fingerprints (fuzz corpus artifacts) must not change shape
+        if self.region is not None:
+            out["region"] = self.region
+        return out
 
 
 @dataclass
@@ -118,7 +169,12 @@ class ChaosSchedule:
 
     ``stable_for``: a fault counts as recovered only once the pipeline has
     been continuously healthy for this many seconds after the fault cleared
-    (``recovered_at`` backdates to the start of that healthy run)."""
+    (``recovered_at`` backdates to the start of that healthy run).
+
+    ``plane``: a GlobalControlPlane scoping health region-by-region — a
+    killed region is then *expected*-unhealthy (the plane's ``healthy()``
+    skips it) instead of pinning the whole drill unrecovered, the
+    single-region assumption ISSUE 19 retires."""
 
     def __init__(
         self,
@@ -126,8 +182,10 @@ class ChaosSchedule:
         faults: list[FaultSpec],
         monitor_interval: float = 1.0,
         stable_for: float = 10.0,
+        plane=None,
     ):
         self.pipeline = pipeline
+        self.plane = plane
         self.monitor_interval = monitor_interval
         self.stable_for = stable_for
         self._armed = [
@@ -159,6 +217,8 @@ class ChaosSchedule:
     def _inject(self, armed: _Armed) -> None:
         now = self.pipeline.clock.now()
         armed.report.injected_at = now
+        region = getattr(self.pipeline, "region", None)
+        armed.report.region = getattr(region, "name", None)
         # the pre-fault replica count, recorded for the report (callers
         # assert final convergence against it when load is held constant)
         armed.report.expected_replicas = self.pipeline.deployment.replicas
@@ -182,42 +242,12 @@ class ChaosSchedule:
             armed.clear_fn = None
 
     def _healthy(self) -> bool:
-        # Healthy = converged and observable: every declared replica running,
-        # no pod looping, every node schedulable, every scrape target
-        # answering, and the HPA able to read its metric.  Deliberately NOT
-        # "replicas == pre-fault count": load may legitimately move the goal
-        # while a fault is live (a spike during a blackout); whether the
-        # *final* count is right is the caller's assertion (storm/tests).
-        pipe = self.pipeline
-        # Every autoscaled tenant must be converged, not just the pipeline's
-        # primary deployment — on a multi-tenant pool (control/capacity.py) a
-        # fault that leaves a SECOND tenant's pods pending is not recovered,
-        # even when the primary looks fine (the latent single-tenant
-        # assumption this check used to carry).
-        controllers = [(pipe.deployment, pipe.hpa)] + [
-            (pipe.cluster.deployments[name], hpa)
-            for name, hpa in getattr(pipe, "tenant_hpas", {}).items()
-        ]
-        for dep, hpa in controllers:
-            running = len(pipe.cluster.running_pods(dep.name))
-            if running != dep.replicas:
-                return False
-            if any(
-                p.phase == "CrashLoopBackOff"
-                for p in pipe.cluster.pods.values()
-                if p.deployment == dep.name
-            ):
-                return False
-            active = hpa.status.condition("ScalingActive")
-            if active is not None and not active.status:
-                return False
-        for node in pipe.cluster.nodes.values():
-            if not (node.ready and node.schedulable):
-                return False
-        for target in pipe.scraper.targets:
-            if not target.healthy:
-                return False
-        return True
+        # Region-scoped when a plane is attached: the plane judges every
+        # ALIVE region with pipeline_healthy and skips killed ones (a dead
+        # region is expected-unhealthy mid-evacuation, not a drill failure).
+        if self.plane is not None:
+            return self.plane.healthy()
+        return pipeline_healthy(self.pipeline)
 
     def _tick(self) -> None:
         now = self.pipeline.clock.now()

@@ -1,0 +1,646 @@
+"""Regions and the global control plane above them (ISSUE 19).
+
+Every PR so far lived under a single-cluster ceiling: one SimCluster, one
+scrape plane, one SlicePool.  This module composes N of those stacks into a
+fleet:
+
+- :class:`Region` wraps one fully-assembled :class:`AutoscalingPipeline`
+  (federated scrape plane, SlicePool + CapacityScheduler, per-tenant HPAs)
+  and gives it a name, a liveness bit, and a locality table;
+- :class:`GlobalControlPlane` runs two loops over the regions, both on the
+  shared virtual clock:
+
+  1. the **exchange loop**: every ``publish_interval`` each alive,
+     unpartitioned region seals its TSDB state into a format-3 snapshot
+     payload and uploads it under the sealed-generation protocol of
+     :mod:`..metrics.global_query`; the plane's
+     :class:`~k8s_gpu_hpa_tpu.metrics.global_query.GlobalQueryLayer` merges
+     the sealed payloads Thanos-style for cross-region reads;
+  2. the **global scheduler**: every ``sync_interval`` it walks tenants in
+     priority order and spills unservable demand across regions — a killed
+     home region (``region_kill``) spills its frozen desired replicas; a
+     saturated one spills its overflow (pods Pending past ``spill_after_s``).
+     Candidate regions are ranked by ``(pool load ratio, data-locality
+     cost, name)``; inside the target region the spilled pods land as
+     registered :class:`TenantSpec` mirrors, so priority and DRF fair-share
+     arbitration still apply pod-by-pod.  Every decision — admitted, denied,
+     drained — is one row in ``decision_log``, the chain ``simulate evacuate
+     --why`` replays across the region boundary.
+
+Mirrors are pre-created at plane construction: every tenant gets a
+``<tenant>-evac`` deployment at 0 replicas in every non-home region, with a
+TenantSpec cloning its priority/weight/budgets.  Spilling is then a pure
+``scale_to`` — no cross-region object creation happens during an incident,
+which is exactly when it would be least likely to work.
+
+Evacuation state machine (per ``region_kill``):
+
+    ALIVE --kill--> DEAD (demand frozen, nodes preempted, autoscaler capped)
+      DEAD --schedule ticks--> SPILLING (mirrors scale out, TTC clock runs)
+      SPILLING --all frozen demand Running on mirrors--> EVACUATED
+        (per-tenant TTC recorded; ``region:evacuation_completed``)
+    DEAD --recover--> ALIVE (nodes restored)
+      ALIVE + home reconverged --schedule tick--> mirrors drained to 0
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_hpa_tpu.control.capacity import CapacityConfig, TenantSpec
+from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
+from k8s_gpu_hpa_tpu.control.hpa import HPABehavior
+from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.metrics.global_query import (
+    GlobalQueryLayer,
+    combined_payload_of,
+    publish_snapshot,
+)
+from k8s_gpu_hpa_tpu.metrics.objstore import ObjectStoreUnavailable, SimObjectStore
+from k8s_gpu_hpa_tpu.obs import coverage
+
+#: mirror deployments are ``<tenant>-evac`` in every non-home region
+MIRROR_SUFFIX = "-evac"
+
+
+def mirror_name(tenant: str) -> str:
+    return tenant + MIRROR_SUFFIX
+
+
+class Region:
+    """One named regional stack: a pipeline plus fleet-level identity.
+
+    ``tenants`` maps each HOME tenant's deployment name to its spec row
+    (the dict shape of :func:`build_region`'s ``tenants`` argument);
+    ``locality`` maps other region names to a relative data-locality cost
+    (missing = 1.0) used to rank spill targets."""
+
+    def __init__(
+        self,
+        name: str,
+        pipeline: AutoscalingPipeline,
+        tenants: dict[str, dict] | None = None,
+        locality: dict[str, float] | None = None,
+    ):
+        self.name = name
+        self.pipeline = pipeline
+        # the back-pointer the region-level fault injectors resolve: a fault
+        # targeting this pipeline finds its region, and through it the plane
+        pipeline.region = self
+        self.tenants = dict(tenants or {})
+        self.locality = dict(locality or {})
+        self.alive = True
+        self.partitioned = False
+        self.plane: GlobalControlPlane | None = None
+        self._kill_depth = 0
+        self._partition_depth = 0
+        self._saved_max_nodes: int | None = None
+        self._dead_node_hook = None
+
+    @property
+    def cluster(self) -> SimCluster:
+        return self.pipeline.cluster
+
+    @property
+    def scheduler(self):
+        return self.pipeline.capacity_scheduler
+
+    def pool_ratio(self) -> float:
+        """used/capacity of the regional pool; a dead pool counts as full."""
+        pool = self.scheduler.pool
+        capacity = pool.capacity()
+        if capacity <= 0:
+            return 1.0
+        return pool.used() / capacity
+
+    def locality_cost(self, other: str) -> float:
+        return float(self.locality.get(other, 1.0))
+
+    def headroom_chips(self) -> int:
+        """Free chips minus chips already committed to unbound (Pending or
+        still-starting) pods — the spill scheduler's admission signal.  Raw
+        ``pool.free()`` would double-count: a higher-priority tenant spilled
+        on the same tick has desired replicas whose pods have not bound yet,
+        and admitting against free() would overcommit the pool."""
+        committed = 0
+        for dep_name, dep in self.cluster.deployments.items():
+            bound = sum(
+                1
+                for p in self.cluster.deployment_pods(dep_name)
+                if p.node is not None
+            )
+            committed += max(0, dep.replicas - bound) * dep.chips_per_pod
+        return self.scheduler.pool.free() - committed
+
+
+def build_region(
+    clock,
+    name: str,
+    tenants: list[dict],
+    node_chips: int,
+    base_nodes: int,
+    slice_quantum: int = 1,
+    autoscaler_max_nodes: int = 0,
+    provision_delay_s: float = 60.0,
+    grace_s: float = 5.0,
+    locality: dict[str, float] | None = None,
+    scrape_shards: int = 2,
+    pod_start_latency: float = 5.0,
+    target_value: float = 40.0,
+    stabilization_s: float = 60.0,
+) -> Region:
+    """Assemble one regional stack on the SHARED clock.
+
+    ``tenants`` rows are dicts with ``name``, ``priority``, ``weight``,
+    ``preemption_budget``, ``starvation_budget_s``, ``chips_per_pod``,
+    ``max_replicas``, ``base_load`` and ``band`` (the TTC-budget band,
+    ``"prod"``/``"batch"``); the first row is the pipeline's primary tenant.
+    Never advances the clock — multiple regions share it, and settling is
+    the scenario's job."""
+    cluster = SimCluster(
+        clock,
+        nodes=[(f"{name}-node-{i}", node_chips) for i in range(base_nodes)],
+        pod_start_latency=pod_start_latency,
+    )
+    specs = [
+        TenantSpec(
+            t["name"],
+            priority=t["priority"],
+            weight=t["weight"],
+            preemption_budget=t["preemption_budget"],
+            starvation_budget_s=t["starvation_budget_s"],
+        )
+        for t in tenants
+    ]
+    config = CapacityConfig(
+        tenants=specs,
+        slice_quantum=slice_quantum,
+        grace_s=grace_s,
+        autoscaler_node_chips=node_chips if autoscaler_max_nodes else None,
+        autoscaler_max_nodes=autoscaler_max_nodes,
+        provision_delay_s=provision_delay_s,
+    )
+    deployments = {
+        t["name"]: SimDeployment(
+            cluster,
+            t["name"],
+            t["name"],
+            chips_per_pod=t["chips_per_pod"],
+            load_fn=lambda now, base=t["base_load"]: base,
+            load_mode="shared",
+        )
+        for t in tenants
+    }
+    primary = tenants[0]
+    cluster.add_deployment(deployments[primary["name"]], replicas=1)
+    behavior = HPABehavior()
+    behavior.scale_down.stabilization_window_seconds = stabilization_s
+    pipeline = AutoscalingPipeline(
+        cluster,
+        deployments[primary["name"]],
+        record=f"{primary['name'].replace('-', '_')}_tensorcore_avg",
+        target_value=target_value,
+        max_replicas=primary["max_replicas"],
+        behavior=behavior,
+        capacity=config,
+        scrape_shards=scrape_shards,
+    )
+    for t in tenants[1:]:
+        cluster.add_deployment(deployments[t["name"]], replicas=1)
+        tenant_behavior = HPABehavior()
+        tenant_behavior.scale_down.stabilization_window_seconds = stabilization_s
+        pipeline.add_tenant_hpa(
+            deployments[t["name"]],
+            target_value=target_value,
+            max_replicas=t["max_replicas"],
+            behavior=tenant_behavior,
+        )
+    return Region(
+        name, pipeline, tenants={t["name"]: t for t in tenants}, locality=locality
+    )
+
+
+class GlobalControlPlane:
+    """The fleet brain: exchange loop + cross-region spill scheduler.
+
+    ``spill_enabled=False`` is the planted canary of the ``region_evacuation``
+    rung: the plane still records every decision, but denies every spill —
+    an evacuation that provably fails its reconvergence budgets."""
+
+    def __init__(
+        self,
+        clock,
+        regions: list[Region],
+        objstore: SimObjectStore,
+        spill_enabled: bool = True,
+        sync_interval: float = 15.0,
+        publish_interval: float = 30.0,
+        spill_after_s: float = 45.0,
+    ):
+        self.clock = clock
+        self.regions: dict[str, Region] = {r.name: r for r in regions}
+        self.objstore = objstore
+        self.spill_enabled = spill_enabled
+        self.sync_interval = sync_interval
+        self.publish_interval = publish_interval
+        self.spill_after_s = spill_after_s
+        self.query = GlobalQueryLayer(clock, objstore)
+        #: tenant -> home region name (tenant names are fleet-unique)
+        self._home: dict[str, str] = {}
+        for region in regions:
+            region.plane = self
+            self.query.register_region(region.name)
+            for tenant in region.tenants:
+                if tenant in self._home:
+                    raise ValueError(f"tenant {tenant} homed in two regions")
+                self._home[tenant] = region.name
+        self._generation: dict[str, int] = {r.name: 0 for r in regions}
+        #: (tenant, region) -> the pre-created mirror deployment there
+        self._mirrors: dict[tuple[str, str], SimDeployment] = {}
+        self._make_mirrors()
+        #: one row per global scheduling decision (the ``--why`` chain)
+        self.decision_log: list[dict] = []
+        #: region lifecycle events (kill/recover/partition/publish failures)
+        self.events: list[dict] = []
+        #: one record per region_kill: frozen demand, per-tenant TTC, states
+        self.evacuations: list[dict] = []
+        self.publishes_total = 0
+        self.publish_failures_total = 0
+        self.spills_admitted = 0
+        self.spills_denied = 0
+        self._started = False
+
+    # ---- construction ------------------------------------------------------
+
+    def _spec(self, tenant: str) -> dict:
+        return self.regions[self._home[tenant]].tenants[tenant]
+
+    def _make_mirrors(self) -> None:
+        """Pre-create every tenant's mirror in every non-home region, with a
+        TenantSpec clone so the target's CapacityScheduler arbitrates spilled
+        pods at the tenant's real priority/weight/budgets."""
+        for tenant, home in self._home.items():
+            spec = self._spec(tenant)
+            for region in self.regions.values():
+                if region.name == home:
+                    continue
+                mirror = mirror_name(tenant)
+                dep = SimDeployment(
+                    region.cluster,
+                    mirror,
+                    mirror,
+                    chips_per_pod=spec["chips_per_pod"],
+                    load_fn=lambda now, base=spec["base_load"]: base,
+                    load_mode="shared",
+                )
+                region.cluster.add_deployment(dep, replicas=0)
+                region.scheduler.tenants[mirror] = TenantSpec(
+                    mirror,
+                    priority=spec["priority"],
+                    weight=spec["weight"],
+                    preemption_budget=spec["preemption_budget"],
+                    starvation_budget_s=spec["starvation_budget_s"],
+                )
+                self._mirrors[(tenant, region.name)] = dep
+
+    # ---- the two loops -----------------------------------------------------
+
+    def start(self) -> None:
+        """Start every regional pipeline plus the plane's own publish and
+        schedule ticks on the shared clock.  Idempotent."""
+        if self._started:
+            return
+        self._started = True
+        for region in self.regions.values():
+            region.pipeline.start()
+        self._periodic(self.publish_interval, self._publish_tick)
+        self._periodic(self.sync_interval, self._schedule_tick)
+
+    def _periodic(self, interval: float, fn) -> None:
+        def tick():
+            fn()
+            self.clock.call_later(interval, tick)
+
+        self.clock.call_later(interval, tick)
+
+    def _event(self, event: str, region: str, detail: str = "") -> None:
+        self.events.append(
+            {"t": self.clock.now(), "event": event, "region": region, "detail": detail}
+        )
+
+    # ---- exchange loop -----------------------------------------------------
+
+    def publish_region(self, name: str, fail_blob_after: int | None = None) -> None:
+        """Seal and upload one region's current TSDB state as the next
+        generation.  An object-store outage fails THIS publish only (the
+        generation number is not burned); a torn upload propagates so the
+        fault injection owns the teardown."""
+        region = self.regions[name]
+        payload = combined_payload_of(region.pipeline.db)
+        generation = self._generation[name] + 1
+        try:
+            publish_snapshot(
+                self.objstore,
+                name,
+                generation,
+                payload,
+                fail_blob_after=fail_blob_after,
+            )
+        except ObjectStoreUnavailable:
+            self.publish_failures_total += 1
+            self._event("publish_failed", name, "object store unavailable")
+            return
+        self._generation[name] = generation
+        self.publishes_total += 1
+
+    def _publish_tick(self) -> None:
+        for region in self.regions.values():
+            if region.alive and not region.partitioned:
+                self.publish_region(region.name)
+
+    # ---- region lifecycle (the fault kinds' targets) -----------------------
+
+    def kill_region(self, name: str) -> None:
+        """A whole region vanishes: demand is frozen at the current desired
+        replicas, every node is preempted (nodes born into the dead window
+        are preempted on arrival), and the regional autoscaler is capped so
+        the dead region cannot quietly resurrect itself.  Depth-counted for
+        overlap-safe clears."""
+        region = self.regions[name]
+        region._kill_depth += 1
+        if region._kill_depth > 1:
+            return
+        now = self.clock.now()
+        region.alive = False
+        frozen = {
+            tenant: region.cluster.deployments[tenant].replicas
+            for tenant in region.tenants
+        }
+        self.evacuations.append(
+            {
+                "region": name,
+                "killed_at": now,
+                "frozen": frozen,
+                "tenant_ttc_s": {},
+                "completed_at": None,
+                "drained_at": None,
+            }
+        )
+        scheduler = region.scheduler
+        autoscaler = scheduler.autoscaler if scheduler is not None else None
+        if autoscaler is not None:
+            region._saved_max_nodes = autoscaler.max_nodes
+            autoscaler.max_nodes = len(autoscaler.provisioned)
+
+        def dead_node_hook(node, cluster=region.cluster):
+            cluster.preempt_node(node.name)
+
+        region._dead_node_hook = dead_node_hook
+        region.cluster.on_node_added.append(dead_node_hook)
+        for node in list(region.cluster.nodes):
+            region.cluster.preempt_node(node)
+        coverage.hit("region:evacuation_started")
+        self._event("region_kill", name, f"frozen demand {frozen}")
+
+    def recover_region(self, name: str) -> None:
+        region = self.regions[name]
+        if region._kill_depth == 0:
+            return
+        region._kill_depth -= 1
+        if region._kill_depth:
+            return
+        if region._dead_node_hook is not None:
+            try:
+                region.cluster.on_node_added.remove(region._dead_node_hook)
+            except ValueError:
+                pass
+            region._dead_node_hook = None
+        for node_name, node in list(region.cluster.nodes.items()):
+            if not (node.ready and node.schedulable):
+                region.cluster.restore_node(node_name)
+        scheduler = region.scheduler
+        autoscaler = scheduler.autoscaler if scheduler is not None else None
+        if autoscaler is not None and region._saved_max_nodes is not None:
+            autoscaler.max_nodes = region._saved_max_nodes
+            region._saved_max_nodes = None
+        region.alive = True
+        self._event("region_recover", name)
+
+    def partition_region(self, name: str) -> None:
+        """A partition severs the exchange plane only: the region keeps
+        serving its local tenants, but stops publishing (global reads serve
+        its last sealed generation) and is skipped as a spill target."""
+        region = self.regions[name]
+        region._partition_depth += 1
+        if region._partition_depth == 1:
+            region.partitioned = True
+            self._event("region_partition", name)
+
+    def heal_partition(self, name: str) -> None:
+        region = self.regions[name]
+        if region._partition_depth == 0:
+            return
+        region._partition_depth -= 1
+        if region._partition_depth == 0:
+            region.partitioned = False
+            self._event("partition_heal", name)
+
+    # ---- the global scheduler ----------------------------------------------
+
+    def _mirror_assigned(self, tenant: str) -> int:
+        return sum(
+            dep.replicas
+            for (t, _), dep in self._mirrors.items()
+            if t == tenant
+        )
+
+    def _mirror_running(self, tenant: str) -> int:
+        total = 0
+        for (t, region_name), dep in self._mirrors.items():
+            if t == tenant:
+                total += len(
+                    self.regions[region_name].cluster.running_pods(dep.name)
+                )
+        return total
+
+    def _spill_demand(self, tenant: str, home: Region) -> tuple[int | None, str]:
+        """How many mirror replicas this tenant needs fleet-wide right now:
+        a dead home spills its FROZEN desired count; a saturated one spills
+        its overflow (pods Pending past ``spill_after_s``); a healthy one
+        spills nothing (None — mirrors drain once home reconverges)."""
+        if not home.alive:
+            for evac in reversed(self.evacuations):
+                if evac["region"] == home.name:
+                    return evac["frozen"].get(tenant, 0), "region_dead"
+            return home.cluster.deployments[tenant].replicas, "region_dead"
+        scheduler = home.scheduler
+        pending = len(scheduler.pending_pods(tenant))
+        if pending and scheduler.open_stint_seconds(tenant) >= self.spill_after_s:
+            return pending, "pool_saturated"
+        return None, ""
+
+    def _schedule_tick(self) -> None:
+        now = self.clock.now()
+        # priority order: the whole point of banded budgets is that prod's
+        # spill lands before batch's competes for the same survivor capacity
+        for tenant in sorted(
+            self._home, key=lambda t: (-self._spec(t)["priority"], t)
+        ):
+            home = self.regions[self._home[tenant]]
+            demand, cause = self._spill_demand(tenant, home)
+            if demand is None:
+                self._maybe_drain(tenant, home, now)
+                continue
+            deficit = demand - self._mirror_assigned(tenant)
+            if deficit > 0:
+                self._place_spill(tenant, home, deficit, cause, now)
+        self._account_evacuations(now)
+
+    def _place_spill(
+        self, tenant: str, home: Region, deficit: int, cause: str, now: float
+    ) -> None:
+        if not self.spill_enabled:
+            self.spills_denied += 1
+            coverage.hit("region:spill_denied")
+            self.decision_log.append(
+                {
+                    "t": now,
+                    "tenant": tenant,
+                    "from": home.name,
+                    "to": None,
+                    "replicas": deficit,
+                    "cause": cause,
+                    "denied": "spill_disabled",
+                }
+            )
+            return
+        spec = self._spec(tenant)
+        chips = spec["chips_per_pod"]
+        candidates = sorted(
+            (
+                r
+                for r in self.regions.values()
+                if r.name != home.name and r.alive and not r.partitioned
+            ),
+            key=lambda r: (r.pool_ratio(), home.locality_cost(r.name), r.name),
+        )
+        for region in candidates:
+            if deficit <= 0:
+                break
+            admit = min(deficit, max(0, region.headroom_chips()) // chips)
+            if admit <= 0:
+                continue
+            dep = self._mirrors[(tenant, region.name)]
+            dep.scale_to(dep.replicas + admit)
+            deficit -= admit
+            self.spills_admitted += 1
+            coverage.hit("region:spill_admitted")
+            self.decision_log.append(
+                {
+                    "t": now,
+                    "tenant": tenant,
+                    "from": home.name,
+                    "to": region.name,
+                    "replicas": admit,
+                    "cause": cause,
+                    "score": [
+                        round(region.pool_ratio(), 3),
+                        home.locality_cost(region.name),
+                    ],
+                }
+            )
+        if deficit > 0:
+            self.spills_denied += 1
+            coverage.hit("region:spill_denied")
+            self.decision_log.append(
+                {
+                    "t": now,
+                    "tenant": tenant,
+                    "from": home.name,
+                    "to": None,
+                    "replicas": deficit,
+                    "cause": cause,
+                    "denied": "no_capacity",
+                }
+            )
+
+    def _maybe_drain(self, tenant: str, home: Region, now: float) -> None:
+        """Home is serving again: once the tenant's own pods are fully
+        Running at desired with nothing Pending, the mirrors scale home."""
+        assigned = self._mirror_assigned(tenant)
+        if assigned == 0:
+            return
+        desired = home.cluster.deployments[tenant].replicas
+        running = len(home.cluster.running_pods(tenant))
+        if running != desired or home.scheduler.pending_pods(tenant):
+            return
+        for (t, region_name), dep in self._mirrors.items():
+            if t == tenant and dep.replicas:
+                dep.scale_to(0)
+                self.decision_log.append(
+                    {
+                        "t": now,
+                        "tenant": tenant,
+                        "from": region_name,
+                        "to": home.name,
+                        "replicas": 0,
+                        "cause": "drain_home_recovered",
+                    }
+                )
+        for evac in self.evacuations:
+            if evac["region"] == home.name and evac["drained_at"] is None:
+                evac["drained_at"] = now
+
+    def _account_evacuations(self, now: float) -> None:
+        for evac in self.evacuations:
+            if evac["completed_at"] is not None:
+                continue
+            for tenant, want in evac["frozen"].items():
+                if tenant in evac["tenant_ttc_s"]:
+                    continue
+                if want == 0 or self._mirror_running(tenant) >= want:
+                    evac["tenant_ttc_s"][tenant] = round(
+                        now - evac["killed_at"], 1
+                    )
+            if len(evac["tenant_ttc_s"]) == len(evac["frozen"]):
+                evac["completed_at"] = now
+                coverage.hit("region:evacuation_completed")
+                self._event(
+                    "evacuation_complete",
+                    evac["region"],
+                    f"ttc {evac['tenant_ttc_s']}",
+                )
+
+    # ---- health + introspection --------------------------------------------
+
+    def healthy(self) -> bool:
+        """Every ALIVE region's pipeline converged-and-observable; a killed
+        region is expected-unhealthy and skipped — the region-scoped health
+        the single-region ``ChaosSchedule._healthy`` could not express."""
+        from k8s_gpu_hpa_tpu.chaos.schedule import pipeline_healthy
+
+        return all(
+            pipeline_healthy(region.pipeline)
+            for region in self.regions.values()
+            if region.alive
+        )
+
+    def explain(self, tenant: str) -> list[dict]:
+        """The tenant's cross-region decision chain, oldest first."""
+        return [d for d in self.decision_log if d["tenant"] == tenant]
+
+    def status(self) -> dict:
+        return {
+            "regions": {
+                name: {
+                    "alive": r.alive,
+                    "partitioned": r.partitioned,
+                    "pool_ratio": round(r.pool_ratio(), 3),
+                    "generation": self._generation[name],
+                }
+                for name, r in sorted(self.regions.items())
+            },
+            "publishes": self.publishes_total,
+            "publish_failures": self.publish_failures_total,
+            "spills_admitted": self.spills_admitted,
+            "spills_denied": self.spills_denied,
+            "evacuations": self.evacuations,
+        }

@@ -1,0 +1,395 @@
+"""The Thanos-style global query layer (ISSUE 19).
+
+Each region periodically seals its TSDB state into a format-3 snapshot
+payload (:meth:`~k8s_gpu_hpa_tpu.metrics.tsdb.TimeSeriesDB.snapshot_payload`
+— the SAME bytes the WAL snapshot writes, so the exchange inherits the
+recovery path's round-trip guarantees) and uploads it to the simulated
+object store under a **sealed-generation protocol**:
+
+1. the payload travels as canonical JSON at ``regions/<R>/gen/<n>``;
+2. only after the blob put returns does the publisher write the seal
+   record ``regions/<R>/seal/<n>`` = ``{"generation", "size", "crc32"}``.
+
+A reader trusts generation ``n`` only when the seal parses AND the blob
+matches the sealed size and CRC.  An uploader killed at any byte —
+mid-blob or mid-seal — therefore leaves either an unsealed blob (no seal:
+invisible) or an unreadable seal (fails validation): the reader falls back
+to the newest older generation that validates, and a torn upload can never
+corrupt the global view (property-tested at every byte offset in
+tests/test_evacuate.py).
+
+:class:`GlobalQueryLayer` merges the per-region sealed payloads into ONE
+:class:`~k8s_gpu_hpa_tpu.metrics.tsdb.TimeSeriesDB` by tagging every series
+with a ``region`` label (disjointness by construction — the Thanos external
+label) and restoring the combined payload through ``TimeSeriesDB.recover``.
+Global queries then run through the ordinary planner/query engine — the PR 7
+semantics are preserved because it IS the same engine — and are bit-identical
+to a single merged reference TSDB built from the live regional DBs (the
+``region_evacuation`` rung's differential gate).
+
+Cache discipline (the single-region-assumption fix of ISSUE 19's satellite):
+payloads cache per region keyed by sealed generation, and
+:meth:`GlobalQueryLayer.invalidate` drops exactly one region's entry — a
+``tsdb_restart`` in region A must never evict region B's cached view.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import zlib
+
+from k8s_gpu_hpa_tpu.metrics.objstore import ObjectStoreUnavailable, SimObjectStore
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import coverage
+
+#: the exchange artifact format this layer speaks: the TSDB snapshot format
+#: (negotiated by ``TimeSeriesDB.recover``, so older payloads restore too)
+EXCHANGE_FORMAT = 3
+
+
+def _gen_key(region: str, generation: int) -> str:
+    return f"regions/{region}/gen/{generation:08d}"
+
+
+def _seal_key(region: str, generation: int) -> str:
+    return f"regions/{region}/seal/{generation:08d}"
+
+
+def encode_payload(payload: dict) -> bytes:
+    """Canonical JSON bytes: sorted keys, no whitespace — the bit-identity
+    contract's serialization (same payload ⇒ same bytes ⇒ same CRC)."""
+    return (json.dumps(payload, sort_keys=True, separators=(",", ":"))).encode(
+        "utf-8"
+    )
+
+
+def publish_snapshot(
+    store: SimObjectStore,
+    region: str,
+    generation: int,
+    payload: dict,
+    fail_blob_after: int | None = None,
+    fail_seal_after: int | None = None,
+) -> dict:
+    """Upload one sealed generation: blob first, seal strictly after.
+
+    The ``fail_*_after`` knobs are the kill-at-any-byte fault surface: they
+    propagate the store's :class:`~.objstore.TornUpload` out of whichever
+    put they tear, leaving exactly the torn prefix behind — the state the
+    reader protocol must survive.  Returns the seal record written."""
+    blob = encode_payload(payload)
+    store.put(_gen_key(region, generation), blob, fail_after=fail_blob_after)
+    seal = {
+        "generation": generation,
+        "size": len(blob),
+        "crc32": zlib.crc32(blob),
+    }
+    store.put(
+        _seal_key(region, generation),
+        encode_payload(seal),
+        fail_after=fail_seal_after,
+    )
+    return seal
+
+
+def read_latest_sealed(
+    store: SimObjectStore, region: str
+) -> tuple[int, dict] | None:
+    """The fallback reader: newest generation whose seal parses AND whose
+    blob matches the sealed size + CRC; every broken newer generation is
+    skipped (the ``global_merge_fallback`` path).  ``None`` when the region
+    has no readable sealed generation at all."""
+    seal_keys = store.list(f"regions/{region}/seal/")
+    for key in reversed(seal_keys):
+        try:
+            seal = json.loads(store.get(key).decode("utf-8"))
+            generation = int(seal["generation"])
+            expected_size = int(seal["size"])
+            expected_crc = int(seal["crc32"])
+            blob = store.get(_gen_key(region, generation))
+            if len(blob) != expected_size or zlib.crc32(blob) != expected_crc:
+                raise ValueError("seal/blob mismatch")
+            payload = json.loads(blob.decode("utf-8"))
+        except ObjectStoreUnavailable:
+            raise
+        except (KeyError, ValueError, TypeError, UnicodeDecodeError):
+            # torn seal, torn blob, or a blob the seal disowns: fall back
+            coverage.hit("region:global_merge_fallback")
+            continue
+        coverage.hit("region:objstore_hit")
+        return generation, payload
+    coverage.hit("region:objstore_miss")
+    return None
+
+
+# ---- payload merge + restore ------------------------------------------------
+
+
+def _tag_labels(labels: list, region: str) -> list:
+    """Add the Thanos-style external ``region`` label and canonicalize the
+    order — the merge's disjointness guarantee (two regions can never
+    collide on a label set that differs in ``region``)."""
+    return sorted([list(pair) for pair in labels] + [["region", region]])
+
+
+def merge_payloads(payloads: dict[str, dict]) -> dict:
+    """Combine per-region snapshot payloads into ONE restorable payload.
+
+    Series (with their verbatim Gorilla columns and rollup state) concatenate
+    under region-tagged labels; version counters sum per name (a sum of
+    monotonics stays monotonic, so planner cache validation keeps its exact
+    semantics on the merged DB); staleness markers and exemplars re-tag the
+    same way.  Regions merge in sorted-name order so the same inputs always
+    produce the same payload bytes."""
+    series: list[dict] = []
+    versions: dict[str, int] = {}
+    stale_pending: list = []
+    exemplars: list = []
+    at = 0.0
+    lookback = None
+    retention = None
+    downsample = None
+    for region in sorted(payloads):
+        p = payloads[region]
+        at = max(at, p["at"])
+        if lookback is None:
+            lookback = p["lookback"]
+        if retention is None:
+            retention = p["retention"]
+        if downsample is None:
+            downsample = p.get("downsample")
+        for entry in p["series"]:
+            tagged = dict(entry)
+            tagged["labels"] = _tag_labels(entry["labels"], region)
+            series.append(tagged)
+        for name, version in p.get("versions", {}).items():
+            versions[name] = versions.get(name, 0) + version
+        for name, labels, ts in p.get("stale_pending", []):
+            stale_pending.append([name, _tag_labels(labels, region), ts])
+        for name, labels, value, trace_id, span_id, ts in p.get(
+            "exemplars", []
+        ):
+            exemplars.append(
+                [name, _tag_labels(labels, region), value, trace_id, span_id, ts]
+            )
+    merged = {
+        "format": EXCHANGE_FORMAT,
+        "at": at,
+        "lookback": 300.0 if lookback is None else lookback,
+        "retention": retention,
+        "series": series,
+        "versions": versions,
+        "stale_pending": stale_pending,
+        "exemplars": exemplars,
+    }
+    if downsample is not None:
+        merged["downsample"] = downsample
+    return merged
+
+
+class _PayloadWAL:
+    """A read-only WAL façade over an in-memory payload: ``recover`` restores
+    the snapshot with an empty tail, and the restored (read-only) view's
+    subsequent appends must not log anywhere — the merged global DB is a
+    query surface, never a write path."""
+
+    def __init__(self, payload: dict):
+        self._payload = payload
+
+    def read(self):
+        return self._payload, []
+
+    def log_append(self, *args, **kwargs) -> None:
+        pass
+
+    def write_snapshot(self, payload: dict) -> None:
+        pass
+
+
+def restore_payload(payload: dict, clock) -> TimeSeriesDB:
+    """Restore one payload into a serving TSDB via the real recovery path
+    (format negotiation, rollup restore, index rebuild — all of it), then
+    detach the façade WAL so the view is cleanly read-only."""
+    db = TimeSeriesDB.recover(_PayloadWAL(payload), clock)
+    db.wal = None
+    return db
+
+
+def combined_payload_of(db) -> dict:
+    """One region-local payload for a pipeline DB: a plain TSDB snapshots
+    itself; a FederatedTSDB merges its members' payloads (labels disjoint
+    across members by ring construction), untagged — the global merge adds
+    the ``region`` label once, at the exchange boundary."""
+    members = getattr(db, "members", None)
+    if members is None:
+        return db.snapshot_payload()
+    payloads = {
+        f"member-{i:02d}": member.snapshot_payload()
+        for i, member in enumerate(members)
+    }
+    merged = merge_payloads(payloads)
+    # member tags are an internal merge device, not a real label: strip them
+    for entry in merged["series"]:
+        entry["labels"] = [
+            pair for pair in entry["labels"] if pair[0] != "region"
+        ]
+    for rec in merged["stale_pending"]:
+        rec[1] = [pair for pair in rec[1] if pair[0] != "region"]
+    for rec in merged["exemplars"]:
+        rec[1] = [pair for pair in rec[1] if pair[0] != "region"]
+    return merged
+
+
+# ---- the global query layer -------------------------------------------------
+
+
+class GlobalQueryLayer:
+    """Merged cross-region reads over the sealed exchange artifacts.
+
+    Per-region payloads cache keyed by sealed generation; the merged DB
+    caches keyed by the full generation vector.  An object-store outage
+    during refresh serves the last sealed view (stale reads beat no reads —
+    the Thanos stance) and counts itself via the ``objstore_outage`` probe.
+    """
+
+    def __init__(self, clock, store: SimObjectStore):
+        self.clock = clock
+        self.store = store
+        self._regions: list[str] = []
+        #: region -> (generation, payload) — invalidate() drops ONE entry
+        self._payloads: dict[str, tuple[int, dict]] = {}
+        self._merged: tuple[tuple, TimeSeriesDB] | None = None
+        self.refreshes_total = 0
+        self.outages_seen = 0
+        self.stale_serves = 0
+
+    def register_region(self, name: str) -> None:
+        if name not in self._regions:
+            self._regions.append(name)
+
+    def invalidate(self, region: str) -> None:
+        """Drop exactly one region's cached payload (and the merged view
+        built over it).  Region-scoped on purpose: a ``tsdb_restart`` in A
+        must never evict B's cache — the cross-region twin of the pipeline's
+        own planner-cache invalidation staying inside its pipeline."""
+        self._payloads.pop(region, None)
+        self._merged = None
+
+    def cached_generation(self, region: str) -> int | None:
+        entry = self._payloads.get(region)
+        return None if entry is None else entry[0]
+
+    def cached_payload(self, region: str) -> dict | None:
+        entry = self._payloads.get(region)
+        return None if entry is None else entry[1]
+
+    def refresh(self) -> dict:
+        """Pull the newest sealed generation per registered region.  Returns
+        ``{"generations": {region: gen|None}, "stale": bool}`` — stale when
+        an outage forced serving cached views."""
+        self.refreshes_total += 1
+        stale = False
+        generations: dict[str, int | None] = {}
+        for region in self._regions:
+            try:
+                latest = read_latest_sealed(self.store, region)
+            except ObjectStoreUnavailable:
+                coverage.hit("region:objstore_outage")
+                self.outages_seen += 1
+                self.stale_serves += 1
+                stale = True
+                generations[region] = self.cached_generation(region)
+                continue
+            if latest is None:
+                generations[region] = self.cached_generation(region)
+                continue
+            generation, payload = latest
+            cached = self._payloads.get(region)
+            if cached is None or cached[0] != generation:
+                self._payloads[region] = (generation, payload)
+            generations[region] = generation
+        return {"generations": generations, "stale": stale}
+
+    def db(self) -> TimeSeriesDB:
+        """The merged global TSDB over every cached sealed payload —
+        refreshed, then rebuilt only when some region's generation moved."""
+        self.refresh()
+        key = tuple(
+            (region, gen) for region, (gen, _) in sorted(self._payloads.items())
+        )
+        if self._merged is None or self._merged[0] != key:
+            merged_payload = merge_payloads(
+                {region: payload for region, (_, payload) in self._payloads.items()}
+            )
+            self._merged = (key, restore_payload(merged_payload, self.clock))
+            coverage.hit("region:global_merge_sealed")
+        return self._merged[1]
+
+    # -- convenience reads (the merged DB serves the real query engine) ------
+
+    def instant_vector(self, name, matchers=None, at=None):
+        return self.db().instant_vector(name, matchers, at)
+
+    def range_avg(self, name, matchers=None, window_s=0.0, at=None, **kwargs):
+        return self.db().range_avg(name, matchers, window_s, at, **kwargs)
+
+    def rollup_range_avg(
+        self, name, matchers=None, window_s=0.0, at=None, step=None, **kwargs
+    ):
+        return self.db().rollup_range_avg(
+            name, matchers, window_s, at, step, **kwargs
+        )
+
+    def status(self) -> dict:
+        return {
+            "regions": list(self._regions),
+            "cached_generations": {
+                region: gen for region, (gen, _) in sorted(self._payloads.items())
+            },
+            "refreshes": self.refreshes_total,
+            "outages_seen": self.outages_seen,
+            "stale_serves": self.stale_serves,
+        }
+
+
+def query_basket(db, names: list[str], windows: list[float], at: float) -> dict:
+    """The canonical comparison basket the bit-identity gates hash: instant
+    vectors plus range averages (and every rollup tier the DB serves) for
+    each name/window, rendered to plain JSON-able rows.  Used on BOTH sides
+    of the differential — the exchange-path global DB and the never-failed
+    merged reference — so any divergence is the exchange's fault."""
+    out: dict = {}
+    for name in sorted(names):
+        rows: dict = {
+            "instant": [
+                [list(s.labels), s.value]
+                for s in db.instant_vector(name, at=at)
+            ]
+        }
+        for window in windows:
+            rows[f"range_{window:g}"] = [
+                [list(s.labels), s.value]
+                for s in db.range_avg(name, window_s=window, at=at)
+            ]
+            for step in getattr(db, "rollup_steps", ()) or ():
+                vec = db.rollup_range_avg(
+                    name, window_s=window, at=at, step=step
+                )
+                rows[f"rollup_{step:g}_{window:g}"] = (
+                    None
+                    if vec is None
+                    else [[list(s.labels), s.value] for s in vec]
+                )
+        out[name] = rows
+    return out
+
+
+def basket_fingerprint(basket: dict) -> str:
+    """Canonical JSON + CRC32 of a query basket — the value two runs compare
+    for bit-identity (small enough to embed in results and artifacts)."""
+    blob = encode_payload(basket)
+    return f"crc32:{zlib.crc32(blob):08x}:{len(blob)}"
+
+
+_B64_DECODE = base64.b64decode  # re-exported for tests poking blob internals

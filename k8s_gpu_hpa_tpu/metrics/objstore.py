@@ -1,0 +1,139 @@
+"""A simulated object store: the cross-region exchange substrate (ISSUE 19).
+
+Thanos ships sealed blocks to S3/GCS and lets every querier read them back;
+this module is that substrate shrunk to the sim's discipline — virtual-clock
+visibility latency instead of network time, an injectable unavailability
+window instead of a cloud incident, and a kill-at-any-byte torn-upload mode
+instead of a crashed uploader.  Everything the multi-region plane exchanges
+(the format-3 TSDB snapshot payloads of :mod:`.tsdb`) travels through
+:class:`SimObjectStore` as opaque bytes under the sealed-generation scheme
+in :mod:`.global_query`, so every failure mode of the exchange is a store
+behavior this module can produce on demand:
+
+- **latency**: a put becomes visible to ``get``/``list`` only once the
+  virtual clock passes ``put time + latency_s`` (writers never see their
+  own writes early either — there is one visibility rule);
+- **unavailability**: while an outage window is open (the
+  ``objstore_outage`` fault kind), every operation raises
+  :class:`ObjectStoreUnavailable`; windows nest via a depth counter so
+  overlapping faults compose the same way the scrape-path faults do;
+- **torn upload**: ``put(..., fail_after=k)`` durably stores exactly the
+  first ``k`` bytes and then raises :class:`TornUpload` — the on-disk
+  state a crashed uploader leaves behind, which the sealed-generation
+  reader must survive at ANY ``k`` (property-tested in
+  tests/test_evacuate.py).
+
+The store is deliberately dumb: no versioning, no conditional puts.  All
+correctness (generations, seals, checksums, fallback) lives in the reader
+protocol one layer up, where it can be tested against this store's worst
+behavior.
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_hpa_tpu.utils.clock import Clock, SystemClock
+
+
+class ObjectStoreUnavailable(ConnectionError):
+    """The store is inside an injected outage window: every call fails."""
+
+
+class TornUpload(RuntimeError):
+    """A put was killed mid-stream; the prefix written so far is durable."""
+
+
+class SimObjectStore:
+    """put/get/list over virtual time with injectable latency and outages."""
+
+    def __init__(self, clock: Clock | None = None, latency_s: float = 0.0):
+        self.clock = clock if clock is not None else SystemClock()
+        self.latency_s = float(latency_s)
+        #: key -> (bytes, visible_at): one visibility rule for every reader
+        self._objects: dict[str, tuple[bytes, float]] = {}
+        self._outage_depth = 0
+        self.puts_total = 0
+        self.gets_total = 0
+        self.lists_total = 0
+        self.torn_uploads_total = 0
+        self.outage_errors_total = 0
+
+    # ---- the outage window (the objstore_outage fault kind) ----------------
+
+    def begin_outage(self) -> None:
+        """Open one outage window; windows nest (overlap-safe clears)."""
+        self._outage_depth += 1
+
+    def end_outage(self) -> None:
+        if self._outage_depth > 0:
+            self._outage_depth -= 1
+
+    @property
+    def available(self) -> bool:
+        return self._outage_depth == 0
+
+    def _check_available(self) -> None:
+        if self._outage_depth > 0:
+            self.outage_errors_total += 1
+            raise ObjectStoreUnavailable(
+                f"object store unavailable (outage depth {self._outage_depth})"
+            )
+
+    # ---- the API -----------------------------------------------------------
+
+    def put(self, key: str, data: bytes, fail_after: int | None = None) -> None:
+        """Store ``data`` under ``key``, visible after the latency window.
+
+        ``fail_after=k`` simulates the uploader dying mid-put: exactly the
+        first ``k`` bytes land durably (immediately torn-visible at the
+        same latency any put would be) and :class:`TornUpload` is raised —
+        the caller never gets to write its seal record, which is what the
+        generation protocol's fallback exists to survive."""
+        self._check_available()
+        visible_at = self.clock.now() + self.latency_s
+        if fail_after is not None and fail_after < len(data):
+            self._objects[key] = (bytes(data[:fail_after]), visible_at)
+            self.torn_uploads_total += 1
+            raise TornUpload(
+                f"put {key!r} killed after {fail_after}/{len(data)} bytes"
+            )
+        self._objects[key] = (bytes(data), visible_at)
+        self.puts_total += 1
+
+    def get(self, key: str) -> bytes:
+        """Fetch a visible object; ``KeyError`` when absent or still inside
+        its visibility latency (an eventually-consistent miss)."""
+        self._check_available()
+        self.gets_total += 1
+        entry = self._objects.get(key)
+        if entry is None or entry[1] > self.clock.now():
+            raise KeyError(key)
+        return entry[0]
+
+    def list(self, prefix: str = "") -> list[str]:
+        """Sorted visible keys under ``prefix`` (sorted so every consumer
+        iterates generations in one deterministic order)."""
+        self._check_available()
+        self.lists_total += 1
+        now = self.clock.now()
+        return sorted(
+            k
+            for k, (_, visible_at) in self._objects.items()
+            if k.startswith(prefix) and visible_at <= now
+        )
+
+    def delete(self, key: str) -> bool:
+        """Drop ``key`` if present (generation pruning); True when removed."""
+        self._check_available()
+        return self._objects.pop(key, None) is not None
+
+    def stats(self) -> dict:
+        return {
+            "objects": len(self._objects),
+            "bytes": sum(len(b) for b, _ in self._objects.values()),
+            "puts": self.puts_total,
+            "gets": self.gets_total,
+            "lists": self.lists_total,
+            "torn_uploads": self.torn_uploads_total,
+            "outage_errors": self.outage_errors_total,
+            "available": self.available,
+        }

@@ -541,6 +541,16 @@ class TimeSeriesDB:
         and no reliance on JSON's non-standard NaN literal)."""
         if self.wal is None:
             return
+        self.wal.write_snapshot(self.snapshot_payload())
+        self._wal_records_since_snapshot = 0
+
+    def snapshot_payload(self) -> dict:
+        """Build (and return) the format-3 snapshot payload without touching
+        the WAL.  This is the WAL snapshot's exact byte content AND the
+        cross-region exchange artifact (metrics/global_query.py): a payload
+        is restorable through :meth:`recover` wherever it lands, so the
+        object-store exchange inherits the recovery path's round-trip
+        guarantees instead of inventing a second serialization."""
         b64 = base64.b64encode
         series_out = []
         for name, by_name in self._data.items():
@@ -599,8 +609,7 @@ class TimeSeriesDB:
                 "horizon": ds.horizon,
                 "retention": ds.retention,
             }
-        self.wal.write_snapshot(payload)
-        self._wal_records_since_snapshot = 0
+        return payload
 
     @classmethod
     def recover(

@@ -50,6 +50,7 @@ DOMAINS = (
     "concurrency",
     "fuzz",
     "profile",
+    "region",
 )
 
 EXPORT_VERSION = 1
@@ -228,6 +229,9 @@ FAULT_PROBE_KINDS = (
     "wal_truncate",
     "tenant_spike",
     "provision_fail",
+    "region_kill",
+    "region_partition",
+    "objstore_outage",
 )
 for _kind in FAULT_PROBE_KINDS:
     probe("fault_kind", _kind, f"chaos injector {_kind} armed")
@@ -369,6 +373,55 @@ probe(
     "profile",
     "export_flame",
     "collapsed-stack (flamegraph) exporter rendered a profile",
+)
+
+# -- region: the multi-region control plane's joints (control/region.py +
+# metrics/global_query.py) — evacuation lifecycle, cross-region spill
+# decisions, and the sealed-generation exchange through the object store.
+probe(
+    "region",
+    "evacuation_started",
+    "a region was killed mid-traffic; demand frozen for evacuation",
+)
+probe(
+    "region",
+    "evacuation_completed",
+    "every frozen replica of a killed region is Running on mirrors",
+)
+probe(
+    "region",
+    "spill_admitted",
+    "global scheduler spilled tenant replicas into a surviving region",
+)
+probe(
+    "region",
+    "spill_denied",
+    "global scheduler could not place a spill (no capacity / disabled)",
+)
+probe(
+    "region",
+    "objstore_hit",
+    "a sealed generation's blob fetched and validated from the store",
+)
+probe(
+    "region",
+    "objstore_miss",
+    "a region had no readable sealed generation in the store",
+)
+probe(
+    "region",
+    "objstore_outage",
+    "global refresh hit the store's outage window; served cached view",
+)
+probe(
+    "region",
+    "global_merge_sealed",
+    "global query layer rebuilt the merged TSDB from sealed payloads",
+)
+probe(
+    "region",
+    "global_merge_fallback",
+    "reader skipped a torn/unsealed generation and fell back to older",
 )
 
 

@@ -203,6 +203,10 @@ COVERAGE_DOMAIN_FLOORS = {
     # four probes synthetically (both exporters, a real-vs-empty diff,
     # an empty-map attribution check); measured 1.00
     "profile": 0.75,
+    # the evacuation coverage session (chaos/evacuate.py) drives the whole
+    # lifecycle — kill/spill/complete, sealed publish + torn-upload
+    # fallback, outage-window stale serve, empty-region miss; measured 1.00
+    "region": 0.75,
 }
 
 # ---- race_sweep smoke (tools/tier1.sh, `simulate races`) -------------------
@@ -259,6 +263,62 @@ FUZZ_MAX_SHRINK_RATIO = 0.67
 #: minimizer/replay probes are also hit deterministically
 FUZZ_COVERAGE_BUDGET = 4
 FUZZ_COVERAGE_SEED = 11
+
+# ---- region_evacuation: the multi-region control plane rung (ISSUE 19) ------
+
+#: fleet shape: three regions on one clock, each a crunch-like pool.  The
+#: home region ("us") hosts the prod+batch tenant pair; the survivors host
+#: one local background tenant each and hold the headroom the evacuation
+#: spills into
+EVAC_REGIONS = ("us", "eu", "ap")
+EVAC_BASE_NODES = 2
+EVAC_NODE_CHIPS = 8
+EVAC_SLICE_QUANTUM = 4
+#: the exchange artifact's object-store visibility latency (put → readable)
+EVAC_OBJSTORE_LATENCY_S = 2.0
+#: global plane loop periods: spill scheduling + sealed-snapshot publish
+EVAC_SYNC_INTERVAL_S = 15.0
+EVAC_PUBLISH_INTERVAL_S = 30.0
+
+#: fault timeline (schedule-relative): the kill lands mid-traffic, an
+#: object-store outage overlaps the evacuation's hot phase, and a partition
+#: of one SURVIVOR ("ap"), opened BEFORE the kill, proves spill targeting
+#: routes around it: prod + part of batch land on "eu", the rest of batch
+#: is denied (``no_capacity``) until the partition heals and "ap" readmits
+EVAC_KILL_AT_S = 60.0
+EVAC_KILL_DURATION_S = 300.0
+EVAC_OUTAGE_AT_S = 120.0
+EVAC_OUTAGE_DURATION_S = 45.0
+EVAC_PARTITION_AT_S = 30.0
+EVAC_PARTITION_DURATION_S = 90.0
+#: settle before arming + total after arming (the tail past kill+recovery
+#: is the reconvergence window the contract checks)
+EVAC_SETTLE_S = 120.0
+EVAC_TOTAL_S = 900.0
+
+#: per-priority-band time-to-reconvergence ceilings: seconds from the kill
+#: to the band's frozen replicas all Running on surviving-region mirrors.
+#: Prod is strictly tighter — its spill is first in priority order and its
+#: mirrors bind into standing headroom (measured ~35-75 s); batch may wait
+#: out fair-share arbitration behind the survivors' own tenants (measured
+#: ~75-150 s).  Margin over measured so scheduler regressions, not jitter,
+#: trip the gate
+EVAC_PROD_TTC_MAX_S = 150.0
+EVAC_BATCH_TTC_MAX_S = 420.0
+
+#: starvation budgets for the SURVIVING regions' own tenants during the
+#: evacuation (the spill must not starve the locals past these)
+EVAC_STARVATION_BUDGETS_S = {
+    "tpu-prod": 120.0,
+    "tpu-batch": 600.0,
+    "eu-local": 600.0,
+    "ap-local": 600.0,
+}
+
+#: smoke sizing (`simulate evacuate --smoke` in tools/tier1.sh): same
+#: three-region lifecycle, shorter dwell and tail
+EVAC_SMOKE_KILL_DURATION_S = 180.0
+EVAC_SMOKE_TOTAL_S = 600.0
 
 # ---- continuous profiling: the obs/profile.py plane (ISSUE 17) -------------
 

@@ -419,7 +419,16 @@ def render_slo_report(result: dict) -> str:
 
 #: the canned runs ``simulate coverage`` can collect under one map — the
 #: same six the coverage_floor bench rung unions (bench.py)
-COVERAGE_RUN_NAMES = ("storm", "crunch", "drill", "slo", "races", "fuzz", "profile")
+COVERAGE_RUN_NAMES = (
+    "storm",
+    "crunch",
+    "drill",
+    "slo",
+    "races",
+    "fuzz",
+    "profile",
+    "evacuate",
+)
 
 
 def run_coverage(run: str = "all", seed: int | None = None) -> dict:
@@ -463,6 +472,15 @@ def run_coverage(run: str = "all", seed: int | None = None) -> dict:
                 )
 
                 run_profile_coverage_session()
+            elif name == "evacuate":
+                # fires the region:* probes deterministically: one smoke
+                # evacuation for the lifecycle, plus the torn-seal
+                # fallback and never-published miss (chaos/evacuate.py)
+                from k8s_gpu_hpa_tpu.chaos.evacuate import (
+                    run_evacuation_coverage_session,
+                )
+
+                run_evacuation_coverage_session()
     return cmap.export()
 
 
@@ -1342,6 +1360,57 @@ def main(args) -> int:
             return 1
         return 0
 
+    if args.scenario == "evacuate":
+        # the multi-region evacuation (chaos/evacuate.py): three regional
+        # stacks under one GlobalControlPlane, region_kill takes the home
+        # region away mid-traffic, the survivors absorb its frozen demand
+        # by (priority, fair share, locality).  Exits non-zero on ANY
+        # fleet-contract violation — a blown per-band TTC budget, a broken
+        # surviving-pool audit, a starved survivor tenant, or a global
+        # query basket that diverged from the merged reference.
+        # --no-spill is the planted canary (must exit 2); --replay replays
+        # a committed tests/scenarios/evac-*.json artifact bit-identically;
+        # --why TENANT prints one tenant's cross-region decision chain.
+        import json as _json
+
+        from k8s_gpu_hpa_tpu.chaos.evacuate import (
+            render_evacuation_report,
+            render_evacuation_why,
+            replay_evacuation_artifact,
+            run_region_evacuation,
+        )
+
+        replay = getattr(args, "replay", None)
+        if replay:
+            try:
+                with open(replay, encoding="utf-8") as f:
+                    artifact = _json.load(f)
+                outcome = replay_evacuation_artifact(artifact)
+            except (OSError, ValueError, KeyError) as e:
+                print(f"simulate evacuate --replay: {e}")
+                return 2
+            if outcome["ok"]:
+                print(
+                    f"scenario {artifact['name']}: reproduced bit-identically "
+                    f"({outcome['actual']['fingerprint']})"
+                )
+                return 0
+            print(f"scenario {artifact['name']}: DID NOT REPRODUCE")
+            print(f"  expected: {outcome['expected']}")
+            print(f"  got:      {outcome['actual']}")
+            return 2
+
+        result = run_region_evacuation(
+            spill_enabled=not getattr(args, "no_spill", False),
+            smoke=getattr(args, "smoke", False),
+        )
+        print(render_evacuation_report(result))
+        why = getattr(args, "why", None)
+        if why:
+            print()
+            print(render_evacuation_why(result, why))
+        return 0 if result["ok"] else 2
+
     if args.scenario == "history":
         # the flight recorder: multi-day diurnal run summarized from the
         # rollup tiers, with a mid-run TSDB crash+WAL-replay — exits
@@ -1523,6 +1592,7 @@ if __name__ == "__main__":
             "races",
             "fuzz",
             "profile",
+            "evacuate",
         ],
     )
     parser.add_argument(
@@ -1579,8 +1649,8 @@ if __name__ == "__main__":
         "--run",
         default=None,
         help="which canned run the 'coverage' scenario collects "
-        "(storm, crunch, drill, slo, races, fuzz, profile, or all; "
-        "default all) or the 'profile' scenario measures "
+        "(storm, crunch, drill, slo, races, fuzz, profile, evacuate, "
+        "or all; default all) or the 'profile' scenario measures "
         "(storm, crunch, scale, or all; default storm)",
     )
     parser.add_argument(
@@ -1603,8 +1673,9 @@ if __name__ == "__main__":
         "--replay",
         default=None,
         metavar="SCENARIO_JSON",
-        help="fuzz: replay a committed corpus artifact (tests/scenarios/*) "
-        "instead of searching; exit 2 unless it reproduces bit-identically",
+        help="fuzz/evacuate: replay a committed corpus artifact "
+        "(tests/scenarios/*) instead of searching/running; exit 2 unless "
+        "it reproduces bit-identically",
     )
     parser.add_argument(
         "--break-grace",
@@ -1672,7 +1743,23 @@ if __name__ == "__main__":
         "--smoke",
         action="store_true",
         help="profile: shrink the 'scale' run to the CI smoke shape "
-        "(perfgates.PROFILE_SCALE_SMOKE_*)",
+        "(perfgates.PROFILE_SCALE_SMOKE_*); evacuate: shorten the kill "
+        "dwell and tail (perfgates.EVAC_SMOKE_*)",
+    )
+    parser.add_argument(
+        "--no-spill",
+        action="store_true",
+        help="evacuate: disable cross-region spilling — the planted canary "
+        "whose evacuation provably fails its reconvergence budgets "
+        "(must exit 2)",
+    )
+    parser.add_argument(
+        "--why",
+        default=None,
+        metavar="TENANT",
+        help="evacuate: after the run, replay TENANT's cross-region "
+        "decision chain (spills admitted/denied, drains) across the "
+        "region boundary",
     )
     parser.add_argument(
         "--floor",

@@ -1,0 +1,517 @@
+"""Region evacuation: the sealed exchange protocol, the global query layer,
+and the fleet contract (ISSUE 19).
+
+Three layers of proof:
+
+- **protocol properties** — kill-at-any-byte over BOTH artifacts of a
+  sealed generation (blob, then seal): whatever prefix a torn upload
+  leaves behind, the reader serves the last fully-sealed generation and
+  never a hybrid.  Exhaustive over every byte offset, not sampled.
+- **bit-identity differential** — the exchange path (snapshot → publish →
+  read → merge → restore) against a directly-merged reference, compared
+  through randomized query baskets spanning raw reads and every rollup
+  tier.  Any divergence is the exchange's fault by construction.
+- **fleet contract** — one smoke evacuation run scored by
+  ``evaluate_evacuation_contract``, each clause proven to FIRE on a
+  doctored result (a gate that can't fail gates nothing), the committed
+  scenario artifact replayed bit-identically, and the CLI exit codes the
+  tier-1 harness leans on (0 green / 2 violation) exercised end-to-end.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+from pathlib import Path
+
+import pytest
+
+from k8s_gpu_hpa_tpu import perfgates
+from k8s_gpu_hpa_tpu.__main__ import main as umbrella_main
+from k8s_gpu_hpa_tpu.chaos.evacuate import (
+    evaluate_evacuation_contract,
+    evacuation_fingerprint,
+    replay_evacuation_artifact,
+    run_region_evacuation,
+)
+from k8s_gpu_hpa_tpu.chaos.faults import FaultSpec
+from k8s_gpu_hpa_tpu.chaos.schedule import RecoveryReport
+from k8s_gpu_hpa_tpu.metrics.downsample import DownsamplePolicy
+from k8s_gpu_hpa_tpu.metrics.global_query import (
+    GlobalQueryLayer,
+    basket_fingerprint,
+    combined_payload_of,
+    encode_payload,
+    merge_payloads,
+    publish_snapshot,
+    query_basket,
+    read_latest_sealed,
+    restore_payload,
+)
+from k8s_gpu_hpa_tpu.metrics.objstore import SimObjectStore, TornUpload
+from k8s_gpu_hpa_tpu.metrics.tsdb import TimeSeriesDB
+from k8s_gpu_hpa_tpu.obs import coverage
+from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
+
+SCENARIO_DIR = Path(__file__).resolve().parent / "scenarios"
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# ---- registry / coverage sync ----------------------------------------------
+# Mirrors test_fuzz's sync tests: every place the evacuation plane must be
+# wired is asserted here, so an unhooked registry is a test failure rather
+# than a silently-dark subsystem.
+
+
+def test_evacuate_is_a_registered_coverage_run():
+    from k8s_gpu_hpa_tpu.simulate import COVERAGE_RUN_NAMES
+
+    assert "evacuate" in COVERAGE_RUN_NAMES
+
+
+def test_region_domain_is_registered_with_a_floor():
+    assert "region" in coverage.DOMAINS
+    assert "region" in perfgates.COVERAGE_DOMAIN_FLOORS
+    assert perfgates.COVERAGE_DOMAIN_FLOORS["region"] > 0.0
+
+
+def test_region_probe_set_is_exactly_the_declared_nine():
+    assert set(coverage.probes_in_domain("region")) == {
+        "region:evacuation_started",
+        "region:evacuation_completed",
+        "region:spill_admitted",
+        "region:spill_denied",
+        "region:objstore_hit",
+        "region:objstore_miss",
+        "region:objstore_outage",
+        "region:global_merge_sealed",
+        "region:global_merge_fallback",
+    }
+
+
+def test_region_evacuation_rung_is_registered_in_bench():
+    import bench
+
+    assert callable(bench.run_rung_region_evacuation)
+    # the registry tuple lives inline in bench.main; the name appearing
+    # next to the callable is what actually wires the rung into a run
+    assert '("region_evacuation", run_rung_region_evacuation)' in (
+        REPO_ROOT / "bench.py"
+    ).read_text()
+
+
+# ---- exchange protocol: kill-at-any-byte -----------------------------------
+
+
+def _small_payloads():
+    """Two generations of a small (fast-to-iterate) snapshot payload."""
+    clock = VirtualClock()
+    db = TimeSeriesDB(clock, lookback=300.0)
+    for i in range(6):
+        db.append("util", (("node", "n0"),), 10.0 + i)
+        db.append("util", (("node", "n1"),), 50.0 - i)
+        clock.advance(5.0)
+    gen1 = db.snapshot_payload()
+    for i in range(4):
+        db.append("util", (("node", "n0"),), 99.0 - i)
+        clock.advance(5.0)
+    gen2 = db.snapshot_payload()
+    assert encode_payload(gen1) != encode_payload(gen2)
+    return clock, gen1, gen2
+
+
+def test_torn_blob_at_every_byte_falls_back_to_last_sealed():
+    """Kill the upload at EVERY byte offset of generation 2's blob: the
+    seal is never written, so the reader must serve generation 1 intact
+    at every single offset — an unsealed blob is invisible by protocol."""
+    clock, gen1, gen2 = _small_payloads()
+    blob2 = encode_payload(gen2)
+    for offset in range(len(blob2)):
+        store = SimObjectStore(clock)
+        publish_snapshot(store, "us", 1, gen1)
+        with pytest.raises(TornUpload):
+            publish_snapshot(store, "us", 2, gen2, fail_blob_after=offset)
+        got = read_latest_sealed(store, "us")
+        assert got is not None, f"blob torn at byte {offset}: lost gen 1"
+        generation, payload = got
+        assert generation == 1, f"blob torn at byte {offset}: served gen 2"
+        assert encode_payload(payload) == encode_payload(gen1)
+
+
+def test_torn_seal_at_every_byte_falls_back_to_last_sealed():
+    """Kill the upload at EVERY byte offset of generation 2's SEAL: the
+    blob is fully durable but the seal is a torn prefix — never valid
+    JSON, so the reader must skip it and serve generation 1."""
+    clock, gen1, gen2 = _small_payloads()
+    seal_len = len(
+        encode_payload(
+            publish_snapshot(SimObjectStore(clock), "probe", 1, gen2)
+        )
+    )
+    for offset in range(seal_len):
+        store = SimObjectStore(clock)
+        publish_snapshot(store, "us", 1, gen1)
+        with pytest.raises(TornUpload):
+            publish_snapshot(store, "us", 2, gen2, fail_seal_after=offset)
+        got = read_latest_sealed(store, "us")
+        assert got is not None, f"seal torn at byte {offset}: lost gen 1"
+        generation, payload = got
+        assert generation == 1, f"seal torn at byte {offset}: served gen 2"
+        assert encode_payload(payload) == encode_payload(gen1)
+
+
+def test_sealed_blob_corrupted_in_place_is_skipped_by_crc():
+    """A seal that disowns its blob (bit-rot after sealing): size matches
+    or not, the CRC check must reject it and fall back a generation."""
+    clock, gen1, gen2 = _small_payloads()
+    store = SimObjectStore(clock)
+    publish_snapshot(store, "us", 1, gen1)
+    publish_snapshot(store, "us", 2, gen2)
+    blob2 = bytearray(encode_payload(gen2))
+    blob2[len(blob2) // 2] ^= 0xFF  # same size, wrong CRC
+    store.put("regions/us/gen/00000002", bytes(blob2))
+    generation, payload = read_latest_sealed(store, "us")
+    assert generation == 1
+    assert encode_payload(payload) == encode_payload(gen1)
+
+
+def test_read_latest_sealed_on_empty_region_is_a_miss():
+    store = SimObjectStore(VirtualClock())
+    assert read_latest_sealed(store, "never-published") is None
+
+
+# ---- bit-identity differential ---------------------------------------------
+
+
+def _build_regional_dbs(clock, rng):
+    """Two downsampled regional DBs driven long enough that sealed chunks
+    age past the horizon — every rollup tier holds real rows."""
+    policy = DownsamplePolicy(steps=(60.0, 300.0), horizon=120.0)
+    dbs = {
+        region: TimeSeriesDB(
+            clock, lookback=300.0, retention=86400.0, downsample=policy
+        )
+        for region in ("us", "eu")
+    }
+    for tick in range(1200):
+        for region, db in dbs.items():
+            db.append("util", (("node", f"{region}-0"),), rng.uniform(0, 100))
+            if tick % 3 == 0:
+                db.append(
+                    "util", (("node", f"{region}-1"),), rng.uniform(0, 100)
+                )
+        clock.advance(1.0)
+    return dbs
+
+
+def test_global_query_bit_identical_to_merged_reference_randomized():
+    """The tentpole's standing differential, isolated from the evacuation
+    scenario: global reads through the FULL exchange path (snapshot →
+    publish → sealed read → merge → restore) must be bit-identical to a
+    direct merge of the same payloads, across seeded-random query windows
+    and anchors AND every rollup tier both sides serve."""
+    rng = random.Random(0xE19)
+    clock = VirtualClock()
+    dbs = _build_regional_dbs(clock, rng)
+
+    store = SimObjectStore(clock)
+    layer = GlobalQueryLayer(clock, store)
+    payloads = {}
+    for region, db in dbs.items():
+        payloads[region] = combined_payload_of(db)
+        publish_snapshot(store, region, 1, payloads[region])
+        layer.register_region(region)
+    global_db = layer.db()
+    reference = restore_payload(merge_payloads(payloads), clock)
+
+    assert tuple(global_db.rollup_steps) == (60.0, 300.0)
+    assert tuple(reference.rollup_steps) == (60.0, 300.0)
+
+    now = clock.now()
+    saw_rollup_rows = False
+    for trial in range(30):
+        if trial % 2 == 0:
+            # raw differential: unaligned float windows and anchors (the
+            # rollup rows are None on BOTH sides — alignment is enforced)
+            windows = sorted(rng.uniform(10.0, 900.0) for _ in range(2))
+            at = now - rng.uniform(0.0, 240.0)
+        else:
+            # tier differential: step-aligned window AND anchor inside the
+            # compacted span, so the rollup rows actually serve
+            step = rng.choice((60.0, 300.0))
+            windows = [step * rng.randint(1, 3)]
+            at = step * rng.randint(max(1, int(300 // step)), int(900 // step))
+        got = query_basket(global_db, ["util"], windows, at)
+        want = query_basket(reference, ["util"], windows, at)
+        assert got == want
+        assert basket_fingerprint(got) == basket_fingerprint(want)
+        saw_rollup_rows = saw_rollup_rows or any(
+            rows for key, rows in got["util"].items()
+            if key.startswith("rollup_") and rows
+        )
+    assert saw_rollup_rows, "differential never exercised a rollup tier"
+
+
+def test_exchange_survives_republish_after_torn_generation():
+    """A torn generation 2 followed by a GOOD generation 3: the reader
+    serves 3 — fallback is per-generation, not a poisoned region."""
+    clock, gen1, gen2 = _small_payloads()
+    store = SimObjectStore(clock)
+    publish_snapshot(store, "us", 1, gen1)
+    with pytest.raises(TornUpload):
+        publish_snapshot(store, "us", 2, gen2, fail_seal_after=3)
+    publish_snapshot(store, "us", 3, gen2)
+    generation, payload = read_latest_sealed(store, "us")
+    assert generation == 3
+    assert encode_payload(payload) == encode_payload(gen2)
+
+
+# ---- global query layer: region-scoped invalidation ------------------------
+
+
+def test_invalidate_is_region_scoped():
+    """``tsdb_restart`` in region A must never evict region B's cached
+    payload — the cross-region twin of planner-cache invalidation staying
+    inside its pipeline (the satellite's restart-invalidation clause)."""
+    clock, gen1, gen2 = _small_payloads()
+    store = SimObjectStore(clock)
+    publish_snapshot(store, "a", 1, gen1)
+    publish_snapshot(store, "b", 1, gen2)
+    layer = GlobalQueryLayer(clock, store)
+    layer.register_region("a")
+    layer.register_region("b")
+    layer.db()
+    cached_b = layer.cached_payload("b")
+    assert cached_b is not None
+
+    layer.invalidate("a")
+    assert layer.cached_generation("a") is None, "A's cache must drop"
+    assert layer.cached_payload("b") is cached_b, "B's cache must survive"
+
+    # the next read repopulates A and still reuses B's object
+    layer.db()
+    assert layer.cached_generation("a") == 1
+    assert layer.cached_payload("b") is cached_b
+
+
+def test_refresh_during_outage_serves_stale_and_counts_it():
+    clock, gen1, _ = _small_payloads()
+    store = SimObjectStore(clock)
+    publish_snapshot(store, "a", 1, gen1)
+    layer = GlobalQueryLayer(clock, store)
+    layer.register_region("a")
+    layer.refresh()
+    assert layer.cached_generation("a") == 1
+
+    store.begin_outage()
+    status = layer.refresh()
+    assert status["stale"] is True
+    assert status["generations"] == {"a": 1}  # the cached view, not a hole
+    assert layer.stale_serves == 1
+    store.end_outage()
+    assert layer.refresh()["stale"] is False
+
+
+# ---- chaos schedule: region attribution ------------------------------------
+
+
+def test_recovery_report_region_absent_when_unset():
+    """Single-cluster reports keep their pre-ISSUE-19 dict shape: the fuzz
+    corpus fingerprints canonical-JSON these dicts, so a new always-on key
+    would invalidate every committed scenario."""
+    report = RecoveryReport(fault=FaultSpec("pod_crash", at=0.0))
+    assert "region" not in report.as_dict()
+    report.region = "us"
+    assert report.as_dict()["region"] == "us"
+
+
+# ---- the evacuation contract ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke_result():
+    return run_region_evacuation(spill_enabled=True, smoke=True)
+
+
+def test_smoke_evacuation_is_green(smoke_result):
+    assert smoke_result["violations"] == []
+    assert smoke_result["ok"] is True
+    assert smoke_result["global"]["bit_identical"] is True
+    assert smoke_result["spills"]["admitted"] >= 1
+    assert smoke_result["spills"]["denied"] >= 1
+    assert smoke_result["all_recovered"] is True
+
+
+def test_smoke_evacuation_is_deterministic(smoke_result):
+    again = run_region_evacuation(spill_enabled=True, smoke=True)
+    assert evacuation_fingerprint(again) == evacuation_fingerprint(
+        smoke_result
+    )
+
+
+def test_prod_band_reconverges_tighter_than_batch(smoke_result):
+    evac = smoke_result["evacuations"][0]
+    prod_ttc = max(
+        ttc for tenant, ttc in evac["tenant_ttc_s"].items()
+        if smoke_result["bands"][tenant] == "prod"
+    )
+    batch_ttc = max(
+        ttc for tenant, ttc in evac["tenant_ttc_s"].items()
+        if smoke_result["bands"][tenant] == "batch"
+    )
+    assert prod_ttc < batch_ttc
+    assert prod_ttc <= perfgates.EVAC_PROD_TTC_MAX_S
+    assert batch_ttc <= perfgates.EVAC_BATCH_TTC_MAX_S
+
+
+def test_prod_budget_strictly_tighter_than_batch():
+    assert perfgates.EVAC_PROD_TTC_MAX_S < perfgates.EVAC_BATCH_TTC_MAX_S
+
+
+@pytest.mark.parametrize(
+    "doctor, expect_fragment",
+    [
+        (
+            lambda r: r["evacuations"][0]["tenant_ttc_s"].update(
+                {"tpu-prod": 1e9}
+            ),
+            "over the",
+        ),
+        (
+            lambda r: r["evacuations"][0]["tenant_ttc_s"].pop("tpu-prod"),
+            "never reconverged",
+        ),
+        (
+            lambda r: r["audits"].update(
+                alive_conserved=False, alive_violations=["t=1: us leaked"]
+            ),
+            "conservation broken",
+        ),
+        (
+            lambda r: r["regions"]["eu"]["tenants"]["eu-local"].update(
+                {"max_pending_stint_s": 1e9}
+            ),
+            "starved",
+        ),
+        (
+            lambda r: r["regions"]["eu"]["mirror_replicas"].update(
+                {"tpu-prod-evac": 2}
+            ),
+            "never drained home",
+        ),
+        (lambda r: r.update(all_recovered=False), "not every fault"),
+        (
+            lambda r: r["global"].update(bit_identical=False),
+            "diverged from the merged reference",
+        ),
+        (
+            lambda r: r.update(
+                decisions=[
+                    d for d in r["decisions"] if d["tenant"] != "tpu-prod"
+                ]
+            ),
+            "no admitted cross-region spill decision",
+        ),
+        (lambda r: r["spills"].update(admitted=0), "no spill was ever admitted"),
+        (lambda r: r["spills"].update(denied=0), "no spill was ever denied"),
+        (
+            lambda r: r["objstore"].update(outage_errors=0),
+            "objstore_outage never bit",
+        ),
+        (
+            lambda r: r["exchange"].update(publish_failures=0),
+            "no publish ever failed",
+        ),
+        (
+            lambda r: r["exchange"]["generations"].update({"ap": 0}),
+            "never sealed a generation",
+        ),
+        (lambda r: r.update(evacuations=[]), "no region was ever killed"),
+    ],
+)
+def test_each_contract_clause_fires(smoke_result, doctor, expect_fragment):
+    """Every clause of the contract proven able to fail: doctor one field
+    of a green result and the matching violation must appear."""
+    doctored = copy.deepcopy(smoke_result)
+    doctor(doctored)
+    violations = evaluate_evacuation_contract(doctored)
+    assert any(expect_fragment in v for v in violations), (
+        f"expected a violation containing {expect_fragment!r}, "
+        f"got {violations!r}"
+    )
+
+
+def test_spill_disabled_canary_fails_the_contract():
+    """The planted non-evacuating control: identical drill, spill turned
+    off — it must provably FAIL (frozen demand never lands anywhere)."""
+    canary = run_region_evacuation(spill_enabled=False, smoke=True)
+    assert canary["ok"] is False
+    assert any("never reconverged" in v for v in canary["violations"])
+
+
+# ---- committed scenario artifact + CLI --------------------------------------
+
+
+def test_committed_evacuation_scenario_replays_bit_identically():
+    artifact = json.loads((SCENARIO_DIR / "evac-smoke.json").read_text())
+    outcome = replay_evacuation_artifact(artifact)
+    assert outcome["ok"], (
+        f"expected {outcome['expected']}, got {outcome['actual']}"
+    )
+
+
+def test_replay_rejects_non_evacuation_artifacts():
+    with pytest.raises(ValueError, match="not an evacuation artifact"):
+        replay_evacuation_artifact({"kind": "fuzz_scenario"})
+
+
+def test_cli_evacuate_smoke_exits_0(capsys):
+    rc = umbrella_main(["simulate", "--scenario", "evacuate", "--smoke"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "time-to-reconvergence" in out or "TTC" in out
+
+
+def test_cli_evacuate_no_spill_canary_exits_2(capsys):
+    rc = umbrella_main(
+        ["simulate", "--scenario", "evacuate", "--smoke", "--no-spill"]
+    )
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_evacuate_replay_committed_scenario_exits_0(capsys):
+    rc = umbrella_main(
+        [
+            "simulate",
+            "--scenario",
+            "evacuate",
+            "--smoke",
+            "--replay",
+            str(SCENARIO_DIR / "evac-smoke.json"),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "reproduced bit-identically" in out
+
+
+def test_cli_evacuate_replay_doctored_fingerprint_exits_2(tmp_path, capsys):
+    artifact = json.loads((SCENARIO_DIR / "evac-smoke.json").read_text())
+    artifact["expect"]["fingerprint"] = "crc32:deadbeef"
+    doctored = tmp_path / "evac-doctored.json"
+    doctored.write_text(json.dumps(artifact))
+    rc = umbrella_main(
+        [
+            "simulate",
+            "--scenario",
+            "evacuate",
+            "--smoke",
+            "--replay",
+            str(doctored),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "DID NOT REPRODUCE" in out

@@ -21,6 +21,8 @@ from k8s_gpu_hpa_tpu.control.capacity import CapacityConfig, TenantSpec
 from k8s_gpu_hpa_tpu.control.checkpoint import InMemoryCheckpointStore
 from k8s_gpu_hpa_tpu.control.cluster import SimCluster, SimDeployment
 from k8s_gpu_hpa_tpu.control.loop import AutoscalingPipeline
+from k8s_gpu_hpa_tpu.control.region import GlobalControlPlane, Region
+from k8s_gpu_hpa_tpu.metrics.objstore import SimObjectStore
 from k8s_gpu_hpa_tpu.metrics.wal import WriteAheadLog
 
 from k8s_gpu_hpa_tpu.utils.clock import VirtualClock
@@ -61,6 +63,12 @@ def make_durable_pipeline(tmp_path):
             provision_timeout_s=15.0,
         ),
     )
+    # a single-region fleet wrapper so the region-level injectors
+    # (region_kill / region_partition / objstore_outage) can resolve their
+    # GlobalControlPlane through pipe.region; the plane's own loops are NOT
+    # started — injector hygiene runs against the pipeline's loop alone
+    region = Region("test-region", pipe)
+    GlobalControlPlane(clock, [region], SimObjectStore(clock))
     pipe.start()
     clock.advance(60.0)  # settle: running pods, WAL records, checkpoints
     return clock, pipe, state
@@ -83,6 +91,9 @@ NATURAL_SPECS: dict[str, dict] = {
     "wal_truncate": dict(params={"records": 8}),
     "tenant_spike": dict(duration=10.0, params={"add": 60.0}),
     "provision_fail": dict(duration=10.0),
+    "region_kill": dict(duration=20.0),
+    "region_partition": dict(duration=10.0),
+    "objstore_outage": dict(duration=10.0),
 }
 
 RESTART_KINDS = {"tsdb_restart", "hpa_restart", "adapter_restart", "wal_truncate"}

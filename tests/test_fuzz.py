@@ -46,6 +46,16 @@ from k8s_gpu_hpa_tpu.obs import coverage
 SCENARIOS_DIR = Path(__file__).resolve().parent / "scenarios"
 
 
+def _fuzz_scenarios() -> list[Path]:
+    """The committed FUZZ corpus: evac-*.json artifacts are region-evacuation
+    scenarios with their own replay harness (tests/test_evacuate.py) and a
+    different schema — feeding one to fuzz.replay_artifact would KeyError."""
+    return sorted(
+        p for p in SCENARIOS_DIR.glob("*.json")
+        if not p.name.startswith("evac-")
+    )
+
+
 # ---- registry sync ----------------------------------------------------------
 
 
@@ -272,7 +282,7 @@ def test_same_seed_campaigns_are_bit_identical():
 
 @pytest.mark.parametrize(
     "scenario",
-    sorted(SCENARIOS_DIR.glob("*.json")),
+    _fuzz_scenarios(),
     ids=lambda p: p.stem,
 )
 def test_committed_scenario_replays_green(scenario):
@@ -287,14 +297,14 @@ def test_committed_scenario_replays_green(scenario):
 
 
 def test_committed_corpus_is_not_empty():
-    assert sorted(SCENARIOS_DIR.glob("*.json")), "regression corpus is empty"
+    assert _fuzz_scenarios(), "regression corpus is empty"
 
 
 # ---- CLI exit codes ---------------------------------------------------------
 
 
 def test_cli_replay_green_scenario_exits_0(capsys):
-    scenario = sorted(SCENARIOS_DIR.glob("*.json"))[0]
+    scenario = _fuzz_scenarios()[0]
     rc = umbrella_main(
         ["simulate", "--scenario", "fuzz", "--replay", str(scenario)]
     )
@@ -307,7 +317,7 @@ def test_cli_replay_doctored_fingerprint_exits_2(tmp_path, capsys):
     recorded fingerprint no longer matches what the sim produces is a dead
     regression test and must fail loudly, not replay vacuously."""
     artifact = json.loads(
-        sorted(SCENARIOS_DIR.glob("*.json"))[0].read_text()
+        _fuzz_scenarios()[0].read_text()
     )
     artifact["expect"]["fingerprint"] = artifact["expect"]["fingerprint"][:-2] + '"'
     doctored = tmp_path / "doctored.json"

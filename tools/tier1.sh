@@ -40,6 +40,14 @@ python -m k8s_gpu_hpa_tpu.simulate drill --components tsdb || exit 1
 # (pool conserved every tick, TTC p95 inside the priority-band gates, no
 # starvation past declared budgets, full convergence after the crunch)
 python -m k8s_gpu_hpa_tpu.simulate crunch || exit 1
+# region-evacuation smoke: kill a region mid-traffic (shortened dwell/tail)
+# and require per-priority-band time-to-reconvergence inside the perfgates
+# budgets, conserved pools in every surviving region, drained mirrors after
+# home recovery, and global sealed-snapshot reads bit-identical to a
+# never-failed merged reference — exit 0 IS the fleet contract (the full
+# dwell plus the spill-disabled canary proof runs in bench.py's
+# region_evacuation rung)
+python -m k8s_gpu_hpa_tpu.simulate evacuate --smoke || exit 1
 # coverage smoke (small sizing: the drill run only): the execution-coverage
 # plane must collect, score, and render without tripping a probe KeyError —
 # the full four-scenario union vs the perfgates floors runs in bench.py's
@@ -69,9 +77,14 @@ python -m k8s_gpu_hpa_tpu.simulate fuzz --budget 8 --seed 7 || exit 1
 python -m k8s_gpu_hpa_tpu.simulate profile --run storm --diff tests/profiles/storm_baseline.json || exit 1
 # corpus replay: every committed scenario under tests/scenarios/ must
 # reproduce its recorded outcome fingerprint bit-for-bit — a minimized
-# fuzz failure is only a regression test if it still fails the same way
+# fuzz failure is only a regression test if it still fails the same way,
+# and a committed evacuation drill (evac-*.json, a different artifact
+# schema) is only a fleet contract if its verdict AND fingerprint hold
 for scenario in tests/scenarios/*.json; do
   [ -e "$scenario" ] || continue
-  python -m k8s_gpu_hpa_tpu.simulate fuzz --replay "$scenario" || exit 1
+  case "$(basename "$scenario")" in
+    evac-*) python -m k8s_gpu_hpa_tpu.simulate evacuate --replay "$scenario" || exit 1 ;;
+    *) python -m k8s_gpu_hpa_tpu.simulate fuzz --replay "$scenario" || exit 1 ;;
+  esac
 done
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
