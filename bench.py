@@ -1982,6 +1982,78 @@ def run_rung_region_evacuation() -> dict:
     }
 
 
+def run_rung_paging_bench() -> dict:
+    """Paging-quality rung (chaos/paging.py + obs/alerting.py +
+    obs/incident.py): the alert router armed over three chaos drills, each
+    held to the paging contract (perfgates PAGING_*):
+
+    - **recall = 1.0** — every injected fault covered by an attributed
+      page (or an honest repeat) inside its window, in all three drills;
+    - **precision** — at least PAGING_PRECISION_FLOOR of pages carry an
+      attributable root cause (fault window, SLO burn, capacity denial,
+      or evacuation decision);
+    - **time-to-page** — p95 within the per-scenario budget
+      (PAGING_TTP_P95_MAX_S);
+    - **canary** — the same evacuation drill with --break-inhibition armed
+      must FAIL on uninhibited duplicate pages (the per-tenant
+      unschedulable pages RegionDead should have explained away) — the
+      gate provably catches a mis-inhibition regression;
+    - **determinism** — two identical drills export bit-identical
+      canonical notification logs, or paged history couldn't be diffed.
+
+    Virtual time throughout; deterministic run-to-run."""
+    import json as _json
+
+    from k8s_gpu_hpa_tpu import perfgates
+    from k8s_gpu_hpa_tpu.chaos.paging import (
+        run_paging_crunch,
+        run_paging_evacuation,
+        run_paging_storm,
+    )
+
+    storm = run_paging_storm()
+    crunch = run_paging_crunch()
+    evac = run_paging_evacuation(smoke=True)
+    canary = run_paging_evacuation(smoke=True, break_inhibition=True)
+    second = run_paging_evacuation(smoke=True)
+    canon = lambda r: _json.dumps(r, sort_keys=True, separators=(",", ":"))  # noqa: E731
+    bit_identical = canon(evac) == canon(second)
+    canary_caught = not canary["ok"] and any(
+        v["kind"] == "uninhibited_duplicate_page"
+        for v in canary["score"]["violations"]
+    )
+
+    def summarize(r: dict) -> dict:
+        s = r["score"]
+        return {
+            "pages": s["pages_total"],
+            "recall": s["recall"],
+            "precision": s["precision"],
+            "ttp_p95_s": s["time_to_page_s"]["p95"],
+            "violations": len(r["violations"]),
+            "ok": r["ok"],
+        }
+
+    return {
+        "mode": "virtual",
+        "metric": "paging contract (recall/precision/time-to-page) + "
+        "mis-inhibition canary + log determinism",
+        "storm": summarize(storm),
+        "crunch": summarize(crunch),
+        "evacuate": summarize(evac),
+        "ttp_budgets_s": dict(perfgates.PAGING_TTP_P95_MAX_S),
+        "canary_caught": canary_caught,
+        "bit_identical": bit_identical,
+        "ok": (
+            storm["ok"]
+            and crunch["ok"]
+            and evac["ok"]
+            and canary_caught
+            and bit_identical
+        ),
+    }
+
+
 def run_rung_query_bench() -> dict:
     """Query-engine rung (metrics/planner.py + scale_harness): the fleet
     aggregate rule basket evaluated naive (logical ``Expr.evaluate``) and
@@ -2556,6 +2628,7 @@ def main() -> None:
             ("recovery_drill", run_rung_recovery_drill),
             ("capacity_crunch", run_rung_capacity_crunch),
             ("region_evacuation", run_rung_region_evacuation),
+            ("paging_bench", run_rung_paging_bench),
             ("coverage_floor", run_rung_coverage_floor),
             ("chaos_fuzz", run_rung_chaos_fuzz),
             ("profile_bench", run_rung_profile_bench),

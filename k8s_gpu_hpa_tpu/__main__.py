@@ -214,6 +214,7 @@ def main(argv: list[str] | None = None) -> int:
             "fuzz",
             "profile",
             "evacuate",
+            "incident",
         ],
         default="spike",
     )
@@ -277,9 +278,10 @@ def main(argv: list[str] | None = None) -> int:
         "--run",
         default=None,
         help="which canned run --scenario coverage collects "
-        "(storm, crunch, drill, slo, races, fuzz, profile, or all; "
-        "default all) or --scenario profile measures "
-        "(storm, crunch, scale, or all; default storm)",
+        "(storm, crunch, drill, slo, races, fuzz, profile, evacuate, "
+        "incident, or all; default all), --scenario profile measures "
+        "(storm, crunch, scale, or all; default storm), or --scenario "
+        "incident pages over (storm, crunch, evacuate; default storm)",
     )
     sim.add_argument(
         "--seed",
@@ -363,7 +365,8 @@ def main(argv: list[str] | None = None) -> int:
         "--smoke",
         action="store_true",
         help="profile: shrink the 'scale' run to the CI smoke shape; "
-        "evacuate: shorten the kill dwell and tail",
+        "evacuate: shorten the kill dwell and tail; incident: page over "
+        "the smoke evacuation drill",
     )
     sim.add_argument(
         "--no-spill",
@@ -374,9 +377,15 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument(
         "--why",
         default=None,
-        metavar="TENANT",
+        metavar="TENANT_OR_INC",
         help="evacuate: replay TENANT's cross-region decision chain after "
-        "the run",
+        "the run; incident: replay incident INC-00N's causal chain",
+    )
+    sim.add_argument(
+        "--break-inhibition",
+        action="store_true",
+        help="incident: arm the test-only mis-inhibition canary (must "
+        "exit 2)",
     )
     sim.add_argument(
         "--floor",

@@ -89,6 +89,7 @@ def _ttc_gate_s(priority: int) -> float:
 def run_capacity_crunch(
     starvation_budget: float | None = None,
     total: float = perfgates.CRUNCH_TOTAL_S,
+    on_pipeline=None,
 ) -> dict:
     """Run the canned crunch; returns a JSON-able result dict with the
     contract already evaluated (``result["ok"]`` / ``result["violations"]``).
@@ -196,6 +197,10 @@ def run_capacity_crunch(
     settled = {name: cluster.deployments[name].replicas for name in deployments}
 
     schedule = ChaosSchedule(pipe, CRUNCH_FAULTS)
+    # paging-harness hook (chaos/paging.py): attach the alert router before
+    # the crunch arms; the crunch result shape is unchanged
+    if on_pipeline is not None:
+        on_pipeline(pipe, schedule)
     schedule.arm()
     clock.advance(total)
 

@@ -138,6 +138,7 @@ def run_region_evacuation(
     spill_enabled: bool = True,
     smoke: bool = False,
     total: float | None = None,
+    on_plane=None,
 ) -> dict:
     """Run the canned evacuation; returns a JSON-able result dict with the
     contract already evaluated (``result["ok"]`` / ``result["violations"]``).
@@ -210,6 +211,10 @@ def run_region_evacuation(
     schedule = ChaosSchedule(
         by_name["us"].pipeline, _evac_faults(kill_duration), plane=plane
     )
+    # paging-harness hook (chaos/paging.py): attach the fleet alert router
+    # before the faults arm; the evacuation result shape is unchanged
+    if on_plane is not None:
+        on_plane(plane, regions, schedule)
     schedule.arm()
     clock.advance(total)
 

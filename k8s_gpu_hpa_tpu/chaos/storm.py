@@ -88,10 +88,14 @@ def run_fault_storm(
     pod_start_latency: float = 12.0,
     total: float = 1000.0,
     seed: int | None = None,
+    on_pipeline=None,
 ) -> dict:
     """Run the canned storm; returns a JSON-able result dict.  ``seed``
     selects a deterministic schedule variant (see storm_faults_for_seed);
-    the default None is the exact historical storm."""
+    the default None is the exact historical storm.  ``on_pipeline``, when
+    given, is called with ``(pipe, schedule)`` after the pipeline settles
+    and before the schedule arms — the paging harness (chaos/paging.py)
+    uses it to attach its alert router without changing the result shape."""
     clock = VirtualClock()
     cluster = SimCluster(
         clock,
@@ -121,6 +125,8 @@ def run_fault_storm(
     settled = pipe.replicas()
 
     schedule = ChaosSchedule(pipe, storm_faults_for_seed(seed))
+    if on_pipeline is not None:
+        on_pipeline(pipe, schedule)
     schedule.arm()
     clock.advance(total)
 

@@ -261,6 +261,12 @@ class AutoscalingPipeline:
             selfmetrics=self.selfmetrics,
             planner=self.planner,
         )
+        #: obs.alerting.AlertRouter, or None — attached by the paging
+        #: harness (chaos/paging.py); polled once per rule-eval tick with
+        #: the labeled firing-alert instances, so routing shares the
+        #: evaluator's cadence instead of owning timers (VirtualClock
+        #: callbacks must never advance the clock)
+        self.page_router = None
 
         def overrides_for(rule: RecordingRule) -> dict[str, str]:
             # each rule's series is addressed at whatever object kind its own
@@ -425,10 +431,13 @@ class AutoscalingPipeline:
 
     def _rule_tick(self) -> None:
         """One rule-eval tick: shard-local rules first (the federation
-        pre-reductions), then the global evaluator that reads them."""
+        pre-reductions), then the global evaluator that reads them, then
+        the alert router observing whatever that evaluation left firing."""
         if self.shard_plane is not None:
             self.shard_plane.evaluate_rules_once()
         self.evaluator.evaluate_once()
+        if self.page_router is not None:
+            self.page_router.observe(self.evaluator.firing_alert_instances())
 
     def _periodic(self, interval: float, fn) -> None:
         def tick():

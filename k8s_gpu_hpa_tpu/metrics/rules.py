@@ -527,6 +527,9 @@ class AlertRule:
     annotations: dict[str, str] = field(default_factory=dict)
     _pending_since: float | None = field(default=None, repr=False)
     firing: bool = field(default=False, repr=False)
+    #: virtual timestamp of the pending → firing transition, None while not
+    #: firing — the active-since the alert router groups and dedups on
+    firing_since: float | None = field(default=None, repr=False)
 
     def evaluate(
         self, db: TimeSeriesDB, at: float | None = None, plan: Expr | None = None
@@ -542,6 +545,7 @@ class AlertRule:
                 coverage.hit("alert_state:resolved")
             self._pending_since = None
             self.firing = False
+            self.firing_since = None
             return False
         if self._pending_since is None:
             self._pending_since = now
@@ -549,6 +553,7 @@ class AlertRule:
         was_firing = self.firing
         self.firing = now - self._pending_since >= self.for_seconds
         if self.firing and not was_firing:
+            self.firing_since = now
             coverage.hit("alert_state:firing")
         return self.firing
 
@@ -772,8 +777,29 @@ class RuleEvaluator:
             alert.evaluate(self.db, plan=plan_for(alert))
         return count
 
+    def firing_alert_instances(self) -> list[dict]:
+        """Labeled firing-alert instances: name, label set, and active-since
+        virtual timestamp.  Plain dicts (not AlertRule references) so the
+        alert router in obs/alerting.py can group, silence, and inhibit on
+        label matchers without reaching back into rule internals; sorted by
+        (name, labels) for a deterministic observation order."""
+        instances = [
+            {
+                "name": a.alert,
+                "labels": dict(a.labels),
+                "annotations": dict(a.annotations),
+                "active_since": a.firing_since,
+            }
+            for a in self.alerts
+            if a.firing
+        ]
+        instances.sort(key=lambda i: (i["name"], sorted(i["labels"].items())))
+        return instances
+
     def firing_alerts(self) -> list[str]:
-        return [a.alert for a in self.alerts if a.firing]
+        # thin wrapper kept for existing callers (simulate.run_slo_check,
+        # tests) that only ever wanted the bare names
+        return [i["name"] for i in self.firing_alert_instances()]
 
 
 def tpu_test_avg_rule(

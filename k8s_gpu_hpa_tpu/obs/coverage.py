@@ -51,6 +51,7 @@ DOMAINS = (
     "fuzz",
     "profile",
     "region",
+    "alerting",
 )
 
 EXPORT_VERSION = 1
@@ -422,6 +423,86 @@ probe(
     "region",
     "global_merge_fallback",
     "reader skipped a torn/unsealed generation and fell back to older",
+)
+
+# -- alerting: the incident-intelligence plane (obs/alerting.py +
+# obs/incident.py) — router notification lifecycle, suppression paths, and
+# the correlator's cause attribution edges.
+probe(
+    "alerting",
+    "group_waiting",
+    "a new aggregation group opened and is waiting out group_wait",
+)
+probe("alerting", "page_sent", "a group's first notification paged")
+probe(
+    "alerting",
+    "update_sent",
+    "an already-paged group's membership changed; one update sent",
+)
+probe(
+    "alerting",
+    "repeat_sent",
+    "a still-firing group re-paged after repeat_interval of quiet",
+)
+probe(
+    "alerting",
+    "resolved_sent",
+    "an empty paged group sent its resolved notification and expired",
+)
+probe(
+    "alerting",
+    "flap_coalesced",
+    "a resolve→re-fire flap inside group_interval rode one update",
+)
+probe(
+    "alerting",
+    "silenced",
+    "an alert instance matched an active silence and was dropped",
+)
+probe(
+    "alerting",
+    "inhibited",
+    "a firing source alert suppressed a matching target instance",
+)
+probe(
+    "alerting",
+    "incident_opened",
+    "the correlator opened an IncidentRecord for a page",
+)
+probe(
+    "alerting",
+    "incident_attributed",
+    "an incident found at least one cause in the evidence window",
+)
+probe(
+    "alerting",
+    "incident_unattributed",
+    "a page had NO attributable cause (the exit-2 contract path)",
+)
+probe(
+    "alerting",
+    "cause_fault_window",
+    "an open chaos fault window attributed as an incident cause",
+)
+probe(
+    "alerting",
+    "cause_slo_burn",
+    "an SLO burn-rate alert attributed as an incident cause",
+)
+probe(
+    "alerting",
+    "cause_scale_event",
+    "a scale event in the window linked into the incident timeline",
+)
+probe(
+    "alerting",
+    "cause_capacity_denial",
+    "a capacity-scheduler denial/preemption linked as a cause",
+)
+probe(
+    "alerting",
+    "cause_evacuation",
+    "a region-evacuation decision linked as a cause",
 )
 
 

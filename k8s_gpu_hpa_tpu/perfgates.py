@@ -207,6 +207,11 @@ COVERAGE_DOMAIN_FLOORS = {
     # lifecycle — kill/spill/complete, sealed publish + torn-upload
     # fallback, outage-window stale serve, empty-region miss; measured 1.00
     "region": 0.75,
+    # the incident coverage session (chaos/paging.py) drives the paging
+    # lifecycle on the evacuation smoke drill plus a deterministic router/
+    # correlator edge exercise (silence, flap-coalesce, repeat, every cause
+    # kind, the unattributed exit-2 path); measured 1.00
+    "alerting": 0.85,
 }
 
 # ---- race_sweep smoke (tools/tier1.sh, `simulate races`) -------------------
@@ -356,3 +361,44 @@ PROFILE_SCALE_SMOKE_HORIZON_S = 600.0
 #: scrape/eval traffic to populate a real ProfileMap for the exporters
 PROFILE_COVERAGE_TARGETS = 10
 PROFILE_COVERAGE_HORIZON_S = 120.0
+
+# ---- paging_bench: the incident-intelligence plane (ISSUE 20) ---------------
+
+#: router timing, Alertmanager semantics on the shared VirtualClock.
+#: group_wait batches a burst into one first page; group_interval throttles
+#: updates for an already-paged group (a flap inside it coalesces into ONE
+#: update — tests/test_alerting.py pins that); repeat_interval re-pages a
+#: still-firing group.  120 s repeat is deliberately shorter than the
+#: Alertmanager 4 h default: it bounds the coverage gap for faults injected
+#: into an ALREADY-firing group (the crunch overlap case) to one interval,
+#: which is what the time-to-page budgets below are specified against
+PAGING_GROUP_WAIT_S = 15.0
+PAGING_GROUP_INTERVAL_S = 60.0
+PAGING_REPEAT_INTERVAL_S = 120.0
+
+#: paging-quality floors against injected-fault ground truth.  Recall is
+#: exact — every injected fault must produce at least one attributed
+#: page/repeat inside its window; a paging plane that misses faults is
+#: worse than none.  Precision has margin: a page is allowed to ride on
+#: burn-rate evidence alone, but the canned scenarios measure 1.00 (every
+#: page attributable), so 0.90 trips on a real attribution regression
+PAGING_RECALL_FLOOR = 1.0
+PAGING_PRECISION_FLOOR = 0.90
+
+#: p95 time-to-page ceilings per canned scenario, seconds from fault
+#: injection to the first covering notification.  Storm faults page fresh
+#: groups (detection + for_seconds 5 + group_wait 15, measured ~25 s);
+#: crunch faults overlap so late faults ride repeats (bounded by
+#: PAGING_REPEAT_INTERVAL_S); the evacuation's region probes detect
+#: within one eval tick (measured ~21 s).  Margin over measured so a
+#: routing regression, not scheduling jitter, trips the gate
+PAGING_TTP_P95_MAX_S = {
+    "storm": 90.0,
+    "crunch": 240.0,
+    "evacuate": 60.0,
+}
+
+#: alert for_seconds for the harness's state-probe rules (chaos/paging.py):
+#: long enough to ride out single-tick blips, short enough to keep
+#: time-to-page inside the budgets above
+PAGING_ALERT_FOR_S = 5.0

@@ -428,6 +428,7 @@ COVERAGE_RUN_NAMES = (
     "fuzz",
     "profile",
     "evacuate",
+    "incident",
 )
 
 
@@ -481,6 +482,16 @@ def run_coverage(run: str = "all", seed: int | None = None) -> dict:
                 )
 
                 run_evacuation_coverage_session()
+            elif name == "incident":
+                # fires all sixteen alerting:* probes deterministically:
+                # one smoke evacuation paging drill (real pages, real
+                # inhibition, real incident attribution) plus synthetic
+                # router/correlator edge exercises (chaos/paging.py)
+                from k8s_gpu_hpa_tpu.chaos.paging import (
+                    run_incident_coverage_session,
+                )
+
+                run_incident_coverage_session()
     return cmap.export()
 
 
@@ -1411,6 +1422,66 @@ def main(args) -> int:
             print(render_evacuation_why(result, why))
         return 0 if result["ok"] else 2
 
+    if args.scenario == "incident":
+        # the incident-intelligence drill (chaos/paging.py): the alert
+        # router armed over a canned chaos scenario, every page correlated
+        # to its causes (obs/incident.py), paging quality scored against
+        # the injected-fault ground truth.  Exits 2 on ANY paging-contract
+        # violation — a missed fault (recall < 1.0), a page with no
+        # attributable cause, a blown time-to-page budget, or an
+        # uninhibited duplicate page.  --break-inhibition is the planted
+        # mis-inhibition canary (must exit 2); --why INC-00N replays one
+        # incident's causal chain as a postmortem timeline.
+        import json as _json
+
+        from k8s_gpu_hpa_tpu.chaos.paging import (
+            run_paging_crunch,
+            run_paging_evacuation,
+            run_paging_storm,
+        )
+        from k8s_gpu_hpa_tpu.obs.incident import (
+            render_incident_report,
+            render_incident_why,
+        )
+
+        smoke = getattr(args, "smoke", False)
+        run = getattr(args, "run", None) or ("evacuate" if smoke else "storm")
+        break_inhibition = getattr(args, "break_inhibition", False)
+        if run == "storm":
+            result = run_paging_storm(
+                seed=getattr(args, "seed", None),
+                break_inhibition=break_inhibition,
+            )
+        elif run == "crunch":
+            result = run_paging_crunch(break_inhibition=break_inhibition)
+        elif run == "evacuate":
+            result = run_paging_evacuation(
+                break_inhibition=break_inhibition, smoke=smoke
+            )
+        else:
+            print(
+                f"simulate incident: unknown --run {run!r} "
+                "(storm, crunch, evacuate)"
+            )
+            return 2
+        json_out = getattr(args, "json_out", None)
+        if json_out:
+            Path(json_out).write_text(
+                _json.dumps(result, sort_keys=True, separators=(",", ":"))
+                + "\n",
+                encoding="utf-8",
+            )
+        print(render_incident_report(result))
+        why = getattr(args, "why", None)
+        if why:
+            print()
+            print(render_incident_why(result, why))
+        if result["violations"]:
+            print()
+            for v in result["violations"]:
+                print(f"paging contract: {v}")
+        return 0 if result["ok"] else 2
+
     if args.scenario == "history":
         # the flight recorder: multi-day diurnal run summarized from the
         # rollup tiers, with a mid-run TSDB crash+WAL-replay — exits
@@ -1593,6 +1664,7 @@ if __name__ == "__main__":
             "fuzz",
             "profile",
             "evacuate",
+            "incident",
         ],
     )
     parser.add_argument(
@@ -1650,17 +1722,18 @@ if __name__ == "__main__":
         default=None,
         help="which canned run the 'coverage' scenario collects "
         "(storm, crunch, drill, slo, races, fuzz, profile, evacuate, "
-        "or all; default all) or the 'profile' scenario measures "
-        "(storm, crunch, scale, or all; default storm)",
+        "incident, or all; default all), the 'profile' scenario measures "
+        "(storm, crunch, scale, or all; default storm), or the 'incident' "
+        "scenario pages over (storm, crunch, evacuate; default storm)",
     )
     parser.add_argument(
         "--seed",
         type=int,
         default=None,
-        help="schedule-variant seed for the 'coverage' scenario's storm "
-        "(chaos/storm.py), the 'races' schedule permutations, and the "
-        "'fuzz' campaign; default is the fixed canned timeline "
-        "(races: seed 0, fuzz: perfgates.FUZZ_SMOKE_SEED)",
+        help="schedule-variant seed for the 'coverage' and 'incident' "
+        "scenarios' storm (chaos/storm.py), the 'races' schedule "
+        "permutations, and the 'fuzz' campaign; default is the fixed "
+        "canned timeline (races: seed 0, fuzz: perfgates.FUZZ_SMOKE_SEED)",
     )
     parser.add_argument(
         "--budget",
@@ -1699,6 +1772,13 @@ if __name__ == "__main__":
         "(default: perfgates.RACE_SWEEP_SCHEDULES)",
     )
     parser.add_argument(
+        "--break-inhibition",
+        action="store_true",
+        help="incident: arm the test-only canary that computes but does "
+        "not apply inhibition — the warning-severity duplicates page with "
+        "would_inhibit > 0 and the paging contract must fail (exit 2)",
+    )
+    parser.add_argument(
         "--break-ordering",
         action="store_true",
         help="races: arm the test-only ordering canary that makes the "
@@ -1710,8 +1790,10 @@ if __name__ == "__main__":
         default=None,
         metavar="PATH",
         help="write the 'coverage' scenario's canonical CoverageMap "
-        "export (bit-identical across same-seed runs) or the 'profile' "
-        "scenario's timed ProfileMap export to PATH",
+        "export (bit-identical across same-seed runs), the 'profile' "
+        "scenario's timed ProfileMap export, or the 'incident' scenario's "
+        "canonical drill result (notification log + incidents + score) "
+        "to PATH",
     )
     parser.add_argument(
         "--diff",
@@ -1744,7 +1826,8 @@ if __name__ == "__main__":
         action="store_true",
         help="profile: shrink the 'scale' run to the CI smoke shape "
         "(perfgates.PROFILE_SCALE_SMOKE_*); evacuate: shorten the kill "
-        "dwell and tail (perfgates.EVAC_SMOKE_*)",
+        "dwell and tail (perfgates.EVAC_SMOKE_*); incident: page over "
+        "the smoke evacuation drill",
     )
     parser.add_argument(
         "--no-spill",
@@ -1756,10 +1839,11 @@ if __name__ == "__main__":
     parser.add_argument(
         "--why",
         default=None,
-        metavar="TENANT",
+        metavar="TENANT_OR_INC",
         help="evacuate: after the run, replay TENANT's cross-region "
         "decision chain (spills admitted/denied, drains) across the "
-        "region boundary",
+        "region boundary; incident: replay incident INC-00N's causal "
+        "chain as a postmortem timeline",
     )
     parser.add_argument(
         "--floor",

@@ -518,3 +518,79 @@ def test_coverage_floor_rung_gates_union_domains_and_gap_list():
         == result["probes_registered"] - len(result["never_hit"])
     )
     assert result["ok"] is True
+
+
+def test_paging_bench_rung_pins_keys_and_gate_logic(monkeypatch):
+    """The paging-quality rung (chaos/paging.py): pin the record shape and
+    the ok-conjunction with the drills stubbed (the real router/correlator
+    joints are tests/test_alerting.py's; the full three-drill sweep plus
+    the mis-inhibition canary proof runs on every unbudgeted bench run and
+    as `simulate incident --smoke` in tools/tier1.sh)."""
+    import bench as bench_mod
+    from k8s_gpu_hpa_tpu.chaos import paging
+
+    def drill(ok=True, violations=()):
+        return {
+            "score": {
+                "pages_total": 3,
+                "recall": 1.0,
+                "precision": 1.0,
+                "time_to_page_s": {"p50": 20.0, "p95": 20.0, "max": 20.0},
+                "violations": list(violations),
+            },
+            "violations": [v["kind"] for v in violations],
+            "ok": ok,
+        }
+
+    canary_violation = {"kind": "uninhibited_duplicate_page"}
+    monkeypatch.setattr(paging, "run_paging_storm", lambda: drill())
+    monkeypatch.setattr(paging, "run_paging_crunch", lambda: drill())
+    monkeypatch.setattr(
+        paging,
+        "run_paging_evacuation",
+        lambda smoke=True, break_inhibition=False: (
+            drill(ok=False, violations=[canary_violation])
+            if break_inhibition
+            else drill()
+        ),
+    )
+    result = bench_mod.run_rung_paging_bench()
+    assert set(result) == {
+        "mode",
+        "metric",
+        "storm",
+        "crunch",
+        "evacuate",
+        "ttp_budgets_s",
+        "canary_caught",
+        "bit_identical",
+        "ok",
+    }
+    assert result["mode"] == "virtual"
+    from k8s_gpu_hpa_tpu import perfgates
+
+    assert result["ttp_budgets_s"] == perfgates.PAGING_TTP_P95_MAX_S
+    assert result["canary_caught"] is True
+    assert result["bit_identical"] is True
+    for scenario in ("storm", "crunch", "evacuate"):
+        assert set(result[scenario]) == {
+            "pages",
+            "recall",
+            "precision",
+            "ttp_p95_s",
+            "violations",
+            "ok",
+        }
+    assert result["ok"] is True
+
+    # the gate is a genuine conjunction: a canary that pages clean (the
+    # mis-inhibition regression going uncaught) fails the rung even with
+    # all three drills green and the log bit-identical
+    monkeypatch.setattr(
+        paging,
+        "run_paging_evacuation",
+        lambda smoke=True, break_inhibition=False: drill(),
+    )
+    result = bench_mod.run_rung_paging_bench()
+    assert result["canary_caught"] is False
+    assert result["ok"] is False

@@ -182,6 +182,7 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
 
     from k8s_gpu_hpa_tpu.control.capacity import POOL_METRIC_NAMES
     from k8s_gpu_hpa_tpu.metrics.schema import CHIP_METRICS
+    from k8s_gpu_hpa_tpu.obs.alerting import ALERTING_METRIC_NAMES
     from k8s_gpu_hpa_tpu.obs.coverage import COVERAGE_METRIC_NAMES
     from k8s_gpu_hpa_tpu.obs.profile import PROFILE_METRIC_NAMES
     from k8s_gpu_hpa_tpu.obs.selfmetrics import (
@@ -240,6 +241,9 @@ def test_grafana_dashboard_matches_generator_and_series_contracts():
         # continuous-profiling self-metrics (obs/profile.py, the
         # Profiling row) — single-sourced so a rename breaks this test
         | set(PROFILE_METRIC_NAMES)
+        # alert-router self-metrics (obs/alerting.py, the Alerting
+        # row) — single-sourced so a rename breaks this test
+        | set(ALERTING_METRIC_NAMES)
     )
     exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
     assert exprs, "dashboard has no queries"

@@ -40,6 +40,12 @@ from k8s_gpu_hpa_tpu.control.capacity import (  # noqa: E402
     POOL_USED_CHIPS,
 )
 from k8s_gpu_hpa_tpu.metrics.rules import SERVE_BW_TARGET  # noqa: E402
+from k8s_gpu_hpa_tpu.obs.alerting import (  # noqa: E402
+    ALERTING_GROUPS_ACTIVE,
+    ALERTING_NOTIFICATIONS_TOTAL,
+    ALERTING_SUPPRESSED_TOTAL,
+    ALERTING_TIME_TO_PAGE,
+)
 from k8s_gpu_hpa_tpu.obs.coverage import (  # noqa: E402
     COVERAGE_HIT_RATIO,
     COVERAGE_PROBES_HIT,
@@ -962,6 +968,85 @@ def build_dashboard() -> dict:
             "bracket map needs a new joint.",
             threshold=0.90,
             max_y=1.2,
+        ),
+        # ---- alerting (obs/alerting.py): what the incident-intelligence
+        # plane actually paged, suppressed, and how fast ----
+        _ts_panel(
+            45,
+            "Alerting: notifications by kind",
+            12,
+            168,
+            [
+                _target(
+                    f"sum by(kind)({ALERTING_NOTIFICATIONS_TOTAL})",
+                    "{{kind}}",
+                    "A",
+                )
+            ],
+            "Notifications appended to the alert-router log, split by kind "
+            "(page, update, repeat, resolved; obs/alerting.py).  Pages "
+            "rising faster than resolves is an incident backlog; updates "
+            "dwarfing pages means groups are churning members inside "
+            "group_interval — flaps being coalesced, working as intended.",
+        ),
+        _ts_panel(
+            46,
+            "Alerting: aggregation groups active",
+            0,
+            176,
+            [
+                _target(
+                    f"{ALERTING_GROUPS_ACTIVE}",
+                    "groups",
+                    "A",
+                )
+            ],
+            "Label groups the router is currently tracking (waiting out "
+            "group_wait or already paged).  Steady state is zero; a count "
+            "that never drains back means some group keeps firing without "
+            "resolving — the repeat_interval re-pages visible in the "
+            "notifications panel.",
+            legend=False,
+        ),
+        _ts_panel(
+            47,
+            "Alerting: suppressed before grouping",
+            12,
+            176,
+            [
+                _target(
+                    f"sum by(reason)({ALERTING_SUPPRESSED_TOTAL})",
+                    "{{reason}}",
+                    "A",
+                )
+            ],
+            "Alert instances dropped before grouping, by reason: silenced "
+            "(matched an active silence) or inhibited (a firing source "
+            "alert explained them away, e.g. RegionDead inhibiting the "
+            "per-tenant unschedulable pages).  Inhibited collapsing to "
+            "zero during a region incident is the mis-inhibition "
+            "regression the paging_bench canary plants.",
+        ),
+        _ts_panel(
+            48,
+            "Alerting: time-to-page quantiles",
+            0,
+            184,
+            [
+                _target(
+                    f"{ALERTING_TIME_TO_PAGE}",
+                    "{{quantile}}",
+                    "A",
+                )
+            ],
+            "Seconds from an alert turning firing to its group's first "
+            "page (group_wait included), p50/p95/max over the run.  The "
+            "red line marks the storm drill's p95 budget "
+            "(perfgates.PAGING_TTP_P95_MAX_S); p95 drifting up means "
+            "group_wait or the alert for_seconds got slower than the "
+            "paging contract.",
+            unit="s",
+            threshold=90,
         ),
     ]
     return {

@@ -87,4 +87,16 @@ for scenario in tests/scenarios/*.json; do
     *) python -m k8s_gpu_hpa_tpu.simulate fuzz --replay "$scenario" || exit 1 ;;
   esac
 done
+# incident smoke: the alert router armed over the smoke evacuation drill
+# (chaos/paging.py + obs/alerting.py + obs/incident.py) — exit 0 IS the
+# paging contract (every injected fault paged inside its window, every
+# page attributed to a cause, p95 time-to-page inside budget, zero
+# uninhibited duplicate pages); the full three-drill sweep runs in
+# bench.py's paging_bench rung
+python -m k8s_gpu_hpa_tpu.simulate incident --smoke || exit 1
+# ...and the planted mis-inhibition canary must provably FAIL (exit 2):
+# with inhibition computed but not applied, the per-tenant unschedulable
+# pages RegionDead should have explained away page with would_inhibit > 0
+python -m k8s_gpu_hpa_tpu.simulate incident --smoke --break-inhibition > /dev/null 2>&1
+[ $? -eq 2 ] || { echo "tier1: mis-inhibition canary did not exit 2"; exit 1; }
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
